@@ -1,0 +1,50 @@
+"""stateright_tpu: a TPU-native model-checking framework.
+
+Provides the capabilities of the reference `stateright` library — a ``Model``
+abstraction for nondeterministic transition systems, always/sometimes/
+eventually property checking, an actor framework that can be both model
+checked and run over UDP, linearizability/sequential-consistency testers,
+symmetry reduction, and an interactive Explorer — with the search engine
+re-designed for TPUs: the BFS frontier is expanded with vmapped bit-packed
+transition kernels, deduplicated against a device-resident hash set, and
+property checks fused into the same pass (``spawn_xla()``), scaling across a
+``jax.sharding.Mesh`` by fingerprint-sharded frontier routing.
+
+The flat namespace mirrors the reference's re-export style
+(``/root/reference/src/lib.rs:145``): ``from stateright_tpu import *`` gives
+``Model``, ``Property``, ``CheckerBuilder`` etc.  JAX is imported lazily —
+the core API and CPU oracle engines work without touching an accelerator.
+"""
+
+from .core import Expectation, Model, Property
+from .fingerprint import fingerprint
+from .checker import (
+    Checker,
+    CheckerBuilder,
+    CheckerVisitor,
+    NondeterministicModelError,
+    Path,
+    PathRecorder,
+    StateRecorder,
+)
+from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "Expectation",
+    "Model",
+    "NondeterministicModelError",
+    "Path",
+    "PathRecorder",
+    "Property",
+    "ReportData",
+    "ReportDiscovery",
+    "Reporter",
+    "StateRecorder",
+    "WriteReporter",
+    "fingerprint",
+]

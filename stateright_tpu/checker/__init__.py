@@ -1,0 +1,14 @@
+from .base import Checker
+from .builder import CheckerBuilder
+from .path import NondeterministicModelError, Path
+from .visitor import CheckerVisitor, PathRecorder, StateRecorder
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "NondeterministicModelError",
+    "Path",
+    "PathRecorder",
+    "StateRecorder",
+]

@@ -1,0 +1,111 @@
+"""CheckerBuilder: fluent checker configuration.
+
+Mirrors ``/root/reference/src/checker.rs:52-248``.  The strategy boundary —
+``spawn_bfs`` / ``spawn_dfs`` / ``spawn_on_demand`` / ``serve`` — is preserved
+and extended with ``spawn_xla()``, the TPU frontier-expansion engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..core import Model
+from .base import Checker
+from .visitor import as_visitor
+
+
+class CheckerBuilder:
+    """Instantiate via ``model.checker()`` (lib.rs:247)."""
+
+    def __init__(self, model: Model):
+        self._model = model
+        self._symmetry: Optional[Callable[[Any], Any]] = None
+        self._target_state_count: Optional[int] = None
+        self._target_max_depth: Optional[int] = None
+        self._thread_count: int = 1
+        self._visitor = None
+
+    # --- terminal strategies ---------------------------------------------
+
+    def spawn_bfs(self) -> Checker:
+        """Breadth-first search; shortest witness paths (checker.rs:155)."""
+        from .search import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self) -> Checker:
+        """Depth-first search; smaller frontier (checker.rs:187)."""
+        from .search import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_on_demand(self) -> Checker:
+        """Demand-driven search: computes nothing until asked
+        (checker.rs:171)."""
+        try:
+            from .on_demand import OnDemandChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "spawn_on_demand() is not available yet in this build"
+            ) from e
+        return OnDemandChecker(self)
+
+    def spawn_xla(self, **kwargs) -> Checker:
+        """TPU/XLA frontier-expansion engine: the whole BFS frontier is
+        expanded per device super-step with vmapped packed transitions,
+        device-resident hash-set dedup, and fused property evaluation.
+
+        Requires the model to implement the :class:`PackedModel` protocol
+        (or be convertible via ``stateright_tpu.xla.auto_pack``).
+        """
+        try:
+            from ..xla import XlaChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "spawn_xla() is not available yet in this build"
+            ) from e
+        return XlaChecker(self, **kwargs)
+
+    def serve(self, addresses) -> Checker:
+        """Starts the interactive Explorer web service (checker.rs:137)."""
+        try:
+            from .explorer import serve
+        except ImportError as e:
+            raise NotImplementedError(
+                "serve() is not available yet in this build"
+            ) from e
+        return serve(self, addresses)
+
+    # --- configuration ----------------------------------------------------
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enables symmetry reduction; states must define
+        ``representative()`` (checker.rs:198-203)."""
+        return self.symmetry_fn(lambda s: s.representative())
+
+    def symmetry_fn(self, representative: Callable[[Any], Any]) -> "CheckerBuilder":
+        self._symmetry = representative
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        """The checker may exceed this count but never stops short of it
+        while more states exist (checker.rs:215-222)."""
+        self._target_state_count = count if count > 0 else None
+        return self
+
+    def target_max_depth(self, depth: int) -> "CheckerBuilder":
+        self._target_max_depth = depth if depth > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        """Accepted for API parity (checker.rs:234). The host engines are
+        sequential; parallelism comes from the XLA engine, which uses every
+        core of every chip in the mesh regardless of this setting."""
+        self._thread_count = thread_count
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        """A function (or CheckerVisitor) applied to every evaluated path
+        (checker.rs:242-247)."""
+        self._visitor = as_visitor(visitor)
+        return self

@@ -1,0 +1,82 @@
+"""DFS engine oracle tests, ported from /root/reference/src/checker/dfs.rs:454-624."""
+
+from stateright_tpu import Model, PathRecorder, Property, StateRecorder
+from stateright_tpu.test_util import Guess, LinearEquation
+
+
+def test_visits_states_in_dfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_dfs().join()
+    assert accessor() == [(0, y) for y in range(28)]
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(2, 4, 7).checker().spawn_dfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 55
+
+    # DFS found this example... (2*0 + 10*27) % 256 == 14
+    assert checker.discovery("solvable").into_actions() == [Guess.INCREASE_Y] * 27
+    # ... but there are other solutions.
+    checker.assert_discovery(
+        "solvable", [Guess.INCREASE_X, Guess.INCREASE_Y, Guess.INCREASE_X]
+    )
+
+
+class _Sys(Model):
+    """Symmetry-reduction regression model (dfs.rs:536-623).
+
+    Processes advance Loading -> Running -> (Paused <-> Running).  A buggy
+    symmetry implementation that enqueues the representative (rather than the
+    original state) collects invalid paths; PathRecorder's reconstruction
+    raises on such paths.  Encoded as state tuples of ints with
+    Paused < Loading < Running to mirror the reference's derived ordering.
+    """
+
+    PAUSED, LOADING, RUNNING = 0, 1, 2
+
+    def init_states(self):
+        return [(self.LOADING, self.LOADING)]
+
+    def actions(self, state, actions):
+        actions.extend([0, 1])
+
+    def next_state(self, state, action):
+        procs = list(state)
+        p = procs[action]
+        procs[action] = self.RUNNING if p in (self.LOADING, self.PAUSED) else self.PAUSED
+        return tuple(procs)
+
+    def properties(self):
+        return [
+            Property.always("visit all states", lambda _, s: True),
+            Property.sometimes(
+                "a process pauses",
+                lambda _, s: s[0] == _Sys.PAUSED or s[1] == _Sys.PAUSED,
+            ),
+        ]
+
+
+def test_can_apply_symmetry_reduction():
+    # 9 states without symmetry reduction.
+    assert _Sys().checker().spawn_dfs().join().unique_state_count() == 9
+    assert _Sys().checker().spawn_bfs().join().unique_state_count() == 9
+
+    # 6 states with symmetry reduction; PathRecorder raises on invalid paths.
+    visitor, _accessor = PathRecorder.new_with_accessor()
+    checker = (
+        _Sys()
+        .checker()
+        .symmetry_fn(lambda s: tuple(sorted(s)))
+        .visitor(visitor)
+        .spawn_dfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 6

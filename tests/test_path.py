@@ -1,0 +1,47 @@
+"""Path reconstruction tests, ported from /root/reference/src/checker/path.rs:223-256
+and checker.rs:643-667."""
+
+import pytest
+
+from stateright_tpu import NondeterministicModelError, Path, fingerprint
+from stateright_tpu.test_util import FnModel, LinearEquation
+
+
+def test_can_build_path_from_fingerprints():
+    model = LinearEquation(2, 10, 14)
+    fp = lambda a, b: fingerprint((a, b))
+    fingerprints = [fp(0, 0), fp(0, 1), fp(1, 1), fp(2, 1)]
+    path = Path.from_fingerprints(model, fingerprints)
+    assert path.last_state() == (2, 1)
+    assert path.last_state() == Path.final_state(model, fingerprints)
+
+
+def test_panics_if_unable_to_reconstruct_init_state():
+    def fn(prev, out):
+        if prev is None:
+            out.append("UNEXPECTED")
+
+    with pytest.raises(NondeterministicModelError):
+        Path.from_fingerprints(FnModel(fn), [fingerprint("expected")])
+
+
+def test_panics_if_unable_to_reconstruct_next_state():
+    def fn(prev, out):
+        out.append("expected" if prev is None else "UNEXPECTED")
+
+    with pytest.raises(NondeterministicModelError):
+        Path.from_fingerprints(
+            FnModel(fn), [fingerprint("expected"), fingerprint("expected")]
+        )
+
+
+def test_encode_and_from_actions():
+    model = LinearEquation(2, 10, 14)
+    from stateright_tpu.test_util import Guess
+
+    path = Path.from_actions(model, (0, 0), [Guess.INCREASE_X, Guess.INCREASE_Y])
+    assert path.into_states() == [(0, 0), (1, 0), (1, 1)]
+    assert path.into_actions() == [Guess.INCREASE_X, Guess.INCREASE_Y]
+    assert len(path.encode().split("/")) == 3
+    # Unreachable inputs return None.
+    assert Path.from_actions(model, (9, 9), [Guess.INCREASE_X]) is None

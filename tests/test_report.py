@@ -1,0 +1,35 @@
+"""Reporter format parity, ported from /root/reference/src/checker.rs:669-758."""
+
+import io
+
+from stateright_tpu import WriteReporter
+from stateright_tpu.test_util import LinearEquation
+
+
+def test_report_includes_property_names_and_paths_bfs():
+    written = io.StringIO()
+    LinearEquation(2, 10, 14).checker().spawn_bfs().report(WriteReporter(written))
+    output = written.getvalue()
+    assert output.startswith(
+        "Checking. states=1, unique=1, depth=0\n"
+        "Done. states=15, unique=12, depth=4, sec="
+    ), output
+    assert output.endswith(
+        'Discovered "solvable" example Path[3]:\n'
+        "- IncreaseX\n"
+        "- IncreaseX\n"
+        "- IncreaseY\n"
+    ), output
+
+
+def test_report_includes_property_names_and_paths_dfs():
+    written = io.StringIO()
+    LinearEquation(2, 10, 14).checker().spawn_dfs().report(WriteReporter(written))
+    output = written.getvalue()
+    assert output.startswith(
+        "Checking. states=1, unique=1, depth=0\n"
+        "Done. states=55, unique=55, depth=28, sec="
+    ), output
+    assert output.endswith(
+        'Discovered "solvable" example Path[27]:\n' + "- IncreaseY\n" * 27
+    ), output
