@@ -1,0 +1,70 @@
+"""Benchmark: states/sec of the XLA checker on two-phase commit.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "states/sec", "vs_baseline": N}``.
+
+The metric is generated-states per second (the reference's own notion of
+throughput: ``state_count / sec`` from its reporter output, report.rs:66-73)
+over a full-coverage check of 2pc with ``BENCH_RM`` resource managers
+(default 8 — large enough that steady-state frontiers keep the chip busy).
+Compilation is excluded (the first super-step triggers it; timing starts
+after).  ``vs_baseline`` is the ratio against the driver-defined north-star
+of 50M states/sec (BASELINE.md).
+
+Runs on the default JAX platform (the axon TPU under the driver); falls back
+to CPU if TPU init fails so the driver always gets a line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+NORTH_STAR = 50_000_000.0
+
+
+def main() -> None:
+    rm = int(os.environ.get("BENCH_RM", "8"))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    try:
+        jax.devices()
+        platform = jax.devices()[0].platform
+    except Exception:  # TPU tunnel unavailable — fall back to CPU
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+        rm = min(rm, 6)
+
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    checker = PackedTwoPhaseSys(rm).checker().spawn_xla(
+        frontier_capacity=1 << int(os.environ.get("BENCH_FRONTIER_POW", "19")),
+        table_capacity=1 << int(os.environ.get("BENCH_TABLE_POW", "24")),
+    )
+    # First block compiles; exclude it from timing but count its states.
+    checker._run_block()
+    t0 = time.monotonic()
+    states_before = checker.state_count()
+    checker.join()
+    elapsed = time.monotonic() - t0
+    states = checker.state_count() - states_before
+    value = states / max(elapsed, 1e-9)
+    checker.assert_properties()
+
+    print(
+        json.dumps(
+            {
+                "metric": f"2pc(rm={rm}) generated states/sec, spawn_xla, {platform}",
+                "value": round(value, 1),
+                "unit": "states/sec",
+                "vs_baseline": round(value / NORTH_STAR, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

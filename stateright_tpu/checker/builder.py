@@ -56,7 +56,7 @@ class CheckerBuilder:
         device-resident hash-set dedup, and fused property evaluation.
 
         Requires the model to implement the :class:`PackedModel` protocol
-        (or be convertible via ``stateright_tpu.xla.auto_pack``).
+        (see ``stateright_tpu.xla`` for the contract).
         """
         try:
             from ..xla import XlaChecker

@@ -76,7 +76,14 @@ def _digest(value: Any, acc: int) -> int:
         return _fold(_fold(acc, _T_BOOL), int(value))
     t = type(value)
     if t is int:
-        return _fold(_fold(acc, _T_INT), value)
+        acc = _fold(acc, _T_INT)
+        if -0x8000_0000_0000_0000 <= value < 0x8000_0000_0000_0000:
+            # Two's-complement fold: injective over the 64-bit range.
+            return _fold(acc, value & MASK64)
+        # Arbitrary-precision ints: fold the full signed magnitude so values
+        # that agree mod 2^64 don't collide.
+        data = value.to_bytes((value.bit_length() + 8) // 8, "little", signed=True)
+        return _hash_bytes(acc, data)
     if t is float:
         return _fold(_fold(acc, _T_FLOAT), int.from_bytes(struct.pack("<d", value), "little"))
     if t is str:

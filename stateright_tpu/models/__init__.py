@@ -1,0 +1,6 @@
+"""Example model families.
+
+Each module provides an object-level :class:`~stateright_tpu.Model` (checkable
+by the host oracle engines) and, where applicable, a packed TPU implementation
+of the same transition system for ``spawn_xla()``.
+"""

@@ -1,0 +1,12 @@
+"""Device-side building blocks for the XLA checker engine.
+
+- :mod:`fphash` — 64-bit (2x uint32 lane) fingerprints of packed states,
+  computed identically by numpy (host) and jnp (device).
+- :mod:`hashset` — a functional open-addressing hash set in device HBM with
+  deterministic batched insert, the TPU replacement for the reference's
+  concurrent visited map (``/root/reference/src/checker/bfs.rs:29-31``).
+"""
+
+from . import fphash, hashset
+
+__all__ = ["fphash", "hashset"]

@@ -1,0 +1,73 @@
+"""64-bit fingerprints of bit-packed states, as two uint32 lanes.
+
+The reference fingerprints states with a fixed-key 64-bit hash
+(``/root/reference/src/lib.rs:327-336``); stability across runs is part of
+the contract because witness paths are reconstructed from fingerprints later.
+
+TPUs have no native 64-bit integer path worth using for this, so the device
+fingerprint is two independent 32-bit murmur3-style lanes (fmix32 finalizer
+constants, public domain) over the state words.  The same function runs under
+numpy on the host — ``stateright_tpu.xla`` uses the host flavor during path
+reconstruction, so host/device agreement is load-bearing and covered by
+differential tests.
+
+The pair (0, 0) is reserved as the hash-set EMPTY sentinel and is remapped.
+"""
+
+from __future__ import annotations
+
+# fmix32 constants (murmur3 finalizer, public domain).
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
+# Per-lane seeds; arbitrary fixed odd constants (stability is what matters).
+_SEED_HI = 0x9E3779B9
+_SEED_LO = 0x517CC1B7
+_WORD_MIX_HI = 0x2545F491
+_WORD_MIX_LO = 0x85157AF5
+
+
+def _fmix32(h, xp):
+    u = xp.uint32
+    h = h ^ (h >> u(16))
+    h = h * u(_C1)
+    h = h ^ (h >> u(13))
+    h = h * u(_C2)
+    h = h ^ (h >> u(16))
+    return h
+
+
+def fingerprint_words(words, xp):
+    """Fingerprint packed states: ``[..., W] uint32 -> ([...], [...])``
+    (hi, lo) uint32 lanes.
+
+    ``xp`` is the array namespace: ``numpy`` on host, ``jax.numpy`` under
+    jit.  Both produce identical bits.
+    """
+    import contextlib
+
+    import numpy as _np
+
+    # numpy warns on (intended, wrapping) uint32 overflow; jnp does not.
+    ctx = _np.errstate(over="ignore") if xp is _np else contextlib.nullcontext()
+    with ctx:
+        u = xp.uint32
+        w_count = words.shape[-1]
+        hi = xp.full(words.shape[:-1], _SEED_HI, dtype=xp.uint32)
+        lo = xp.full(words.shape[:-1], _SEED_LO, dtype=xp.uint32)
+        for i in range(w_count):
+            w = words[..., i].astype(xp.uint32)
+            hi = _fmix32(hi ^ (w * u(_WORD_MIX_HI) + u(i + 1)), xp)
+            lo = _fmix32(
+                lo ^ (w * u(_WORD_MIX_LO) + u(0x61C88647 * (i + 1) & 0xFFFFFFFF)), xp
+            )
+        # Reserve (0, 0) for the hash-set EMPTY sentinel.
+        is_sentinel = (hi == u(0)) & (lo == u(0))
+        lo = xp.where(is_sentinel, u(1), lo)
+        return hi, lo
+
+
+def fingerprint_u64(words, xp) -> "int | object":
+    """Convenience: fingerprint as a python-int-compatible 64-bit value
+    (host-side use only)."""
+    hi, lo = fingerprint_words(words, xp)
+    return (int(hi) << 32) | int(lo)

@@ -1,0 +1,141 @@
+"""Device-resident open-addressing hash set with deterministic batched insert.
+
+This is the TPU-native replacement for the reference's concurrent visited
+map — ``DashMap<Fingerprint, Option<Fingerprint>>`` with its insert-if-vacant
+race (``/root/reference/src/checker/bfs.rs:29-31, 349-363``).  On a TPU there
+are no atomics to lean on; instead each probe round elects one winner per
+slot with a commutative scatter-min (order-independent, hence deterministic),
+winners claim their slot with conflict-free scatters, and losers keep probing.
+
+Layout: four uint32 planes of length ``capacity`` (a power of two) —
+``key_hi``/``key_lo`` hold the 64-bit fingerprint, ``val_hi``/``val_lo`` hold
+the predecessor fingerprint used for witness-path reconstruction (the same
+parent-pointer scheme as bfs.rs:351).  EMPTY is key == (0, 0);
+``fphash.fingerprint_words`` never produces that pair.
+
+Everything is functional (donated/threaded through jit) and shape-static, so
+the whole super-step fuses into one XLA program; per-round cost is a few
+O(batch) gathers/scatters plus one O(capacity) claim-buffer fill.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+
+class HashSet(NamedTuple):
+    key_hi: "jax.Array"  # [C] uint32
+    key_lo: "jax.Array"  # [C] uint32
+    val_hi: "jax.Array"  # [C] uint32
+    val_lo: "jax.Array"  # [C] uint32
+
+    @property
+    def capacity(self) -> int:
+        return self.key_hi.shape[0]
+
+
+def make(capacity: int, xp) -> HashSet:
+    """An empty hash set with ``capacity`` slots (power of two)."""
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    z = xp.zeros((capacity,), dtype=xp.uint32)
+    return HashSet(z, z, z, z)
+
+
+def insert(
+    hs: HashSet,
+    fp_hi,
+    fp_lo,
+    val_hi,
+    val_lo,
+    active,
+    *,
+    max_probes: int = 32,
+) -> Tuple[HashSet, "jax.Array", "jax.Array"]:
+    """Insert a batch of fingerprints; returns ``(hs', is_new, overflow)``.
+
+    - ``is_new[i]``: the fingerprint was not present and this batch element
+      won the slot (exactly one winner among in-batch duplicates; the winner
+      is the lowest batch index, for determinism).
+    - ``overflow[i]``: still unresolved after ``max_probes`` linear-probe
+      rounds — the caller must grow/rehash (the reference leans on DashMap
+      resizing; here growth is an explicit host-driven rehash).
+
+    Shape-static, jit-friendly; all elections are commutative scatter-mins,
+    so results do not depend on scatter execution order.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cap = hs.capacity
+    mask = jnp.uint32(cap - 1)
+    m = fp_hi.shape[0]
+    ticket = jnp.arange(m, dtype=jnp.int32)
+    sentinel = jnp.int32(2**31 - 1)
+
+    slot0 = ((fp_hi ^ (fp_lo * jnp.uint32(0x9E3779B1))) & mask).astype(jnp.int32)
+    done0 = ~active
+    is_new0 = jnp.zeros((m,), dtype=jnp.bool_)
+
+    def round_fn(_, carry):
+        slot, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t = carry
+        kh = key_hi[slot]
+        kl = key_lo[slot]
+        occupied = (kh != 0) | (kl != 0)
+        match = occupied & (kh == fp_hi) & (kl == fp_lo)
+        done = done | match
+        cand = ~done & ~occupied
+        # Elect one winner per slot: lowest batch index (scatter-min is
+        # commutative => deterministic regardless of execution order).
+        claim = jnp.full((cap,), sentinel, dtype=jnp.int32)
+        claim = claim.at[slot].min(jnp.where(cand, ticket, sentinel))
+        winner = cand & (claim[slot] == ticket)
+        # Winners have unique slots; their writes are conflict-free.
+        # Losers are routed out of range and dropped.
+        widx = jnp.where(winner, slot, cap)
+        key_hi = key_hi.at[widx].set(fp_hi, mode="drop")
+        key_lo = key_lo.at[widx].set(fp_lo, mode="drop")
+        val_hi_t = val_hi_t.at[widx].set(val_hi, mode="drop")
+        val_lo_t = val_lo_t.at[widx].set(val_lo, mode="drop")
+        is_new = is_new | winner
+        done = done | winner
+        # Advance only probes blocked by a different key; election losers
+        # retry the same slot (they may be in-batch duplicates of the new
+        # winner and must observe its key next round).
+        bump = ~done & occupied & ~match
+        slot = jnp.where(
+            bump,
+            ((slot.astype(jnp.uint32) + jnp.uint32(1)) & mask).astype(jnp.int32),
+            slot,
+        )
+        return slot, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t
+
+    slot, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t = jax.lax.fori_loop(
+        0, max_probes, round_fn, (slot0, done0, is_new0, *hs)
+    )
+    overflow = ~done
+    return HashSet(key_hi, key_lo, val_hi_t, val_lo_t), is_new, overflow
+
+
+def lookup(hs: HashSet, fp_hi, fp_lo, *, max_probes: int = 32):
+    """Batched membership + value lookup: returns ``(found, val_hi, val_lo)``."""
+    import jax.numpy as jnp
+
+    cap = hs.capacity
+    mask = jnp.uint32(cap - 1)
+    slot = ((fp_hi ^ (fp_lo * jnp.uint32(0x9E3779B1))) & mask).astype(jnp.int32)
+    found = jnp.zeros(fp_hi.shape, dtype=jnp.bool_)
+    vh = jnp.zeros(fp_hi.shape, dtype=jnp.uint32)
+    vl = jnp.zeros(fp_hi.shape, dtype=jnp.uint32)
+    live = jnp.ones(fp_hi.shape, dtype=jnp.bool_)
+    for _ in range(max_probes):
+        kh = hs.key_hi[slot]
+        kl = hs.key_lo[slot]
+        occupied = (kh != 0) | (kl != 0)
+        match = live & occupied & (kh == fp_hi) & (kl == fp_lo)
+        vh = jnp.where(match, hs.val_hi[slot], vh)
+        vl = jnp.where(match, hs.val_lo[slot], vl)
+        found = found | match
+        live = live & occupied & ~match
+        slot = ((slot.astype(jnp.uint32) + jnp.uint32(1)) & mask).astype(jnp.int32)
+    return found, vh, vl

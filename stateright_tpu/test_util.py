@@ -103,6 +103,68 @@ class DGraph(Model):
 # --- function model (test_util.rs:121-139) --------------------------------
 
 
+class PackedDGraph(DGraph):
+    """A :class:`DGraph` that also implements the PackedModel protocol.
+
+    States are node ids in one uint32 word; the successor grid and property
+    predicate values are baked into dense device tables at construction.
+    This is the primary semantics fixture for the XLA engine: every
+    checker-semantics test over explicit edge lists runs identically on the
+    device engine.
+    """
+
+    state_words = 1
+
+    def __init__(self, graph: DGraph):
+        super().__init__(graph.inits, graph.edges, graph._property)
+        import numpy as np
+
+        n_nodes = 256
+        self.max_actions = max(
+            (len(dsts) for dsts in self.edges.values()), default=1
+        )
+        succ = np.zeros((n_nodes, self.max_actions), dtype=np.uint32)
+        valid = np.zeros((n_nodes, self.max_actions), dtype=bool)
+        for src, dsts in self.edges.items():
+            for k, dst in enumerate(sorted(dsts)):
+                succ[src, k] = dst
+                valid[src, k] = True
+        self._succ = succ
+        self._valid = valid
+        props = self.properties()
+        prop_table = np.zeros((n_nodes, len(props)), dtype=bool)
+        for node in range(n_nodes):
+            for j, p in enumerate(props):
+                prop_table[node, j] = bool(p.condition(self, node))
+        self._prop_table = prop_table
+
+    def pack(self, state: int):
+        import numpy as np
+
+        return np.array([state], dtype=np.uint32)
+
+    def unpack(self, words) -> int:
+        return int(words[0])
+
+    def packed_init(self):
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self.init_states()])
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+
+        node = words[0].astype(jnp.int32)
+        succ = jnp.asarray(self._succ)[node]  # [A]
+        valid = jnp.asarray(self._valid)[node]  # [A]
+        return succ[:, None], valid
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._prop_table)[words[0].astype(jnp.int32)]
+
+
 class FnModel(Model):
     """A model defined by one function ``f(prev_or_None, out_actions)``.
 

@@ -1,0 +1,480 @@
+"""The TPU/XLA frontier-expansion checker: ``spawn_xla()``.
+
+This is the engine the framework exists for.  Where the reference explores
+the state graph one state at a time across CPU worker threads with a
+work-stealing job market (``/root/reference/src/checker/bfs.rs:89-211``), the
+XLA checker is *level-synchronous*: the entire BFS frontier is expanded in
+one fused device program per super-step —
+
+1. evaluate all property predicates over the frontier (fused, mirroring the
+   per-state checks of bfs.rs:279-325),
+2. expand every state's full action grid with a vmapped bit-packed
+   transition kernel (the traced form of ``actions``+``next_state``,
+   bfs.rs:332-333),
+3. fingerprint all candidates (two uint32 murmur lanes, the device analogue
+   of lib.rs:332),
+4. deduplicate against a device-resident open-addressing hash set storing
+   predecessor fingerprints (replacing the DashMap of bfs.rs:29-31),
+5. detect terminal states for eventually-property counterexamples
+   (bfs.rs:374-381), and
+6. stream-compact the surviving states into the next frontier.
+
+Only a handful of scalars (frontier count, discovery flags, overflow flags)
+cross back to the host per super-step; witness paths are reconstructed from
+the device parent table only on demand, by forward re-execution (the TLC
+technique the reference uses, path.rs:20-97).
+
+Work distribution needs no job market: the frontier array IS the work queue,
+and every core processes it data-parallel.  Multi-chip scaling shards the
+frontier and hash set by fingerprint ownership over a ``jax.sharding.Mesh``
+(see ``stateright_tpu/parallel``).
+
+## PackedModel protocol
+
+A model checkable by this engine exposes its transition system as fixed-width
+kernels over bit-packed uint32 state words:
+
+- ``state_words: int`` — W, uint32 lanes per state.
+- ``max_actions: int`` — A, static action-slot count.
+- ``packed_init() -> np.ndarray[N0, W]`` — packed initial states.
+- ``packed_step(words[W]) -> (next[A, W], valid[A])`` — the full action
+  fan-out of one state; jnp-traceable.  ``valid=False`` covers disabled
+  actions, ``next_state -> None`` no-ops, and boundary exclusion
+  (bfs.rs:333-336 collapse into one mask).
+- ``packed_properties(words[W]) -> bool[P]`` — property conditions, ordered
+  as ``properties()``.
+- ``pack(state) / unpack(words)`` — host codec between object states and
+  packed words (used for witness reconstruction and the Explorer).
+- ``packed_representative(words[W]) -> words[W]`` — optional, for symmetry
+  reduction: the device form of ``Representative`` (representative.rs:65).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .checker.base import Checker
+from .checker.path import Path
+from .core import Expectation, Model
+from .ops import fphash, hashset
+
+
+def _require_packed(model: Model) -> None:
+    missing = [
+        attr
+        for attr in ("state_words", "max_actions", "packed_init", "packed_step", "packed_properties")
+        if not hasattr(model, attr)
+    ]
+    if missing:
+        raise TypeError(
+            f"spawn_xla() requires the PackedModel protocol; {type(model).__name__} "
+            f"is missing {missing}. See stateright_tpu.xla for the contract."
+        )
+
+
+class XlaChecker(Checker):
+    """Level-synchronous BFS on an accelerator. One ``_run_block`` = one
+    frontier super-step (one BFS level)."""
+
+    def __init__(
+        self,
+        builder,
+        *,
+        frontier_capacity: int = 1 << 15,
+        table_capacity: int = 1 << 20,
+        max_probes: int = 32,
+    ):
+        import jax
+
+        model = builder._model
+        _require_packed(model)
+        self._model = model
+        self._jax = jax
+        self._symmetry = builder._symmetry is not None
+        if self._symmetry and not hasattr(model, "packed_representative"):
+            raise TypeError(
+                f"symmetry reduction under spawn_xla() requires "
+                f"{type(model).__name__}.packed_representative"
+            )
+        self._target_state_count: Optional[int] = builder._target_state_count
+        self._target_max_depth: Optional[int] = builder._target_max_depth
+        self._visitor = builder._visitor
+        self._properties = model.properties()
+        self._prop_names = [p.name for p in self._properties]
+        # Eventually-property bit assignment: position among the eventually
+        # subset (checker.rs:540-547).
+        self._ebit_of_prop: Dict[int, int] = {}
+        for i, p in enumerate(self._properties):
+            if p.expectation == Expectation.EVENTUALLY:
+                self._ebit_of_prop[i] = len(self._ebit_of_prop)
+        self._ebits0 = (1 << len(self._ebit_of_prop)) - 1
+
+        self._max_probes = max_probes
+        self._W = model.state_words
+        self._A = model.max_actions
+        self._P = len(self._properties)
+
+        # --- device state ------------------------------------------------
+        import jax.numpy as jnp
+
+        init_packed = np.asarray(model.packed_init(), dtype=np.uint32)
+        # Boundary filter on init states (bfs.rs:52-56) is the model's
+        # responsibility at packed_init time; the object-level default
+        # applies it here for safety.
+        keep = [model.within_boundary(model.unpack(row)) for row in init_packed]
+        init_packed = init_packed[keep]
+        n_init = len(init_packed)
+
+        self._frontier_capacity = max(frontier_capacity, 1 << max(n_init.bit_length(), 4))
+        self._table = hashset.make(table_capacity, jnp)
+        # Insert init fingerprints with a zero parent (the "no predecessor"
+        # marker, like the None predecessor of bfs.rs:59-65).
+        dedup_init = self._dedup_words_host(init_packed)
+        ihi, ilo = fphash.fingerprint_words(dedup_init, np)
+        self._table, is_new, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
+            self._table,
+            jnp.asarray(ihi),
+            jnp.asarray(ilo),
+            jnp.zeros(n_init, jnp.uint32),
+            jnp.zeros(n_init, jnp.uint32),
+            jnp.ones(n_init, jnp.bool_),
+            max_probes=self._max_probes,
+        )
+        if bool(np.any(np.asarray(ovf))):  # pragma: no cover - tiny tables only
+            raise RuntimeError("hash table overflow while inserting init states")
+        n_unique_init = int(np.sum(np.asarray(is_new)))
+
+        self._frontier = self._pad_rows(init_packed, self._frontier_capacity)
+        self._frontier_ebits = jnp.where(
+            jnp.arange(self._frontier_capacity) < n_init, jnp.uint32(self._ebits0), jnp.uint32(0)
+        )
+        self._frontier_count = n_init
+        self._depth = 1  # depth of states in the current frontier (bfs.rs:83)
+        self._max_depth = 0
+        self._state_count = n_init
+        self._unique_count = n_unique_init
+        self._disc_found = jnp.zeros(self._P, jnp.bool_)
+        self._disc_fp = jnp.zeros((self._P, 2), jnp.uint32)
+        self._found_names: Dict[str, int] = {}  # name -> fp64, pinned on first find
+        self._exhausted = n_init == 0
+        self._target_reached = False
+        self._superstep_cache: Dict[int, Any] = {}
+
+    # --- helpers ----------------------------------------------------------
+
+    def _pad_rows(self, rows: np.ndarray, cap: int):
+        import jax.numpy as jnp
+
+        out = np.zeros((cap, self._W), dtype=np.uint32)
+        out[: len(rows)] = rows
+        return jnp.asarray(out)
+
+    def _dedup_words_host(self, rows: np.ndarray) -> np.ndarray:
+        """Host-side dedup-key transform: representative packing when
+        symmetry is on (the packed analogue of dfs.rs:357-362)."""
+        if not self._symmetry:
+            return rows
+        reps = [
+            self._model.pack(self._model.unpack(row).representative()) for row in rows
+        ]
+        return np.stack(reps) if reps else rows
+
+    def _packed_fp64(self, state: Any) -> int:
+        """Host fingerprint of an object state, through the packed codec —
+        must agree with device fingerprints (differentially tested)."""
+        words = np.asarray(self._model.pack(state), dtype=np.uint32)[None, :]
+        words = self._dedup_words_host(words)
+        return fphash.fingerprint_u64(words[0], np)
+
+    # --- the fused super-step ---------------------------------------------
+
+    def _build_superstep(self, f_cap: int):
+        import jax
+        import jax.numpy as jnp
+
+        model = self._model
+        prop_specs = [(i, p.expectation) for i, p in enumerate(self._properties)]
+        ebit_of_prop = dict(self._ebit_of_prop)
+        symmetry = self._symmetry
+        A, W = self._A, self._W
+        max_probes = self._max_probes
+
+        def dedup_words(words):
+            return model.packed_representative(words) if symmetry else words
+
+        def superstep(frontier, f_ebits, f_count, table, disc_found, disc_fp):
+            f_valid = jnp.arange(f_cap) < f_count
+            dw = jax.vmap(dedup_words)(frontier)
+            fhi, flo = fphash.fingerprint_words(dw, jnp)
+
+            # 1. fused property evaluation over the frontier.
+            props = jax.vmap(model.packed_properties)(frontier)  # [F, P]
+            for i, expectation in prop_specs:
+                if expectation == Expectation.EVENTUALLY:
+                    bit = jnp.uint32(1 << ebit_of_prop[i])
+                    sat = props[:, i] & f_valid
+                    f_ebits = jnp.where(sat, f_ebits & ~bit, f_ebits)
+                    continue
+                if expectation == Expectation.ALWAYS:
+                    viol = ~props[:, i] & f_valid
+                else:  # SOMETIMES: an example is a "discovery" too
+                    viol = props[:, i] & f_valid
+                has = jnp.any(viol)
+                first = jnp.argmax(viol)
+                take = has & ~disc_found[i]
+                disc_fp = disc_fp.at[i, 0].set(jnp.where(take, fhi[first], disc_fp[i, 0]))
+                disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
+                disc_found = disc_found.at[i].set(disc_found[i] | has)
+
+            # 2. full action-grid expansion.
+            nxt, valid = jax.vmap(model.packed_step)(frontier)  # [F,A,W], [F,A]
+            valid = valid & f_valid[:, None]
+            step_states = jnp.sum(valid, dtype=jnp.int32)
+
+            # 3. fingerprint candidates.
+            cand = nxt.reshape(f_cap * A, W)
+            cdw = jax.vmap(dedup_words)(cand)
+            chi, clo = fphash.fingerprint_words(cdw, jnp)
+            par_hi = jnp.broadcast_to(fhi[:, None], (f_cap, A)).reshape(-1)
+            par_lo = jnp.broadcast_to(flo[:, None], (f_cap, A)).reshape(-1)
+
+            # 4. dedup against the visited set.
+            table, is_new, ovf = hashset.insert(
+                table, chi, clo, par_hi, par_lo, valid.reshape(-1), max_probes=max_probes
+            )
+            step_unique = jnp.sum(is_new, dtype=jnp.int32)
+            table_overflow = jnp.any(ovf)
+
+            # 5. terminal detection for eventually counterexamples
+            #    (bfs.rs:374-381; duplicates count as successors).
+            terminal = f_valid & ~jnp.any(valid, axis=1)
+            for i, expectation in prop_specs:
+                if expectation != Expectation.EVENTUALLY:
+                    continue
+                bit = jnp.uint32(1 << ebit_of_prop[i])
+                viol = terminal & ((f_ebits & bit) != 0)
+                has = jnp.any(viol)
+                first = jnp.argmax(viol)
+                take = has & ~disc_found[i]
+                disc_fp = disc_fp.at[i, 0].set(jnp.where(take, fhi[first], disc_fp[i, 0]))
+                disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
+                disc_found = disc_found.at[i].set(disc_found[i] | has)
+
+            # 6. stream-compact survivors into the next frontier.
+            child_ebits = jnp.broadcast_to(f_ebits[:, None], (f_cap, A)).reshape(-1)
+            pos = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+            new_count = jnp.sum(is_new, dtype=jnp.int32)
+            idx = jnp.where(is_new & (pos < f_cap), pos, f_cap)
+            new_frontier = jnp.zeros((f_cap, W), jnp.uint32).at[idx].set(cand, mode="drop")
+            new_ebits = jnp.zeros((f_cap,), jnp.uint32).at[idx].set(child_ebits, mode="drop")
+            frontier_overflow = new_count > f_cap
+
+            return (
+                new_frontier,
+                new_ebits,
+                new_count,
+                table,
+                disc_found,
+                disc_fp,
+                step_states,
+                step_unique,
+                table_overflow,
+                frontier_overflow,
+            )
+
+        return jax.jit(superstep)
+
+    def _superstep_for(self, f_cap: int):
+        fn = self._superstep_cache.get(f_cap)
+        if fn is None:
+            fn = self._build_superstep(f_cap)
+            self._superstep_cache[f_cap] = fn
+        return fn
+
+    def _grow_table(self) -> None:
+        """Rehash the visited set into a table of twice the capacity."""
+        import jax
+        import jax.numpy as jnp
+
+        old = self._table
+        occupied = (old.key_hi != 0) | (old.key_lo != 0)
+        bigger = hashset.make(old.capacity * 2, jnp)
+        bigger, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
+            bigger,
+            old.key_hi,
+            old.key_lo,
+            old.val_hi,
+            old.val_lo,
+            occupied,
+            max_probes=self._max_probes,
+        )
+        if bool(np.any(np.asarray(ovf))):  # pragma: no cover
+            raise RuntimeError("rehash overflow — pathological fingerprint distribution")
+        self._table = bigger
+
+    def _run_block(self, max_count: int = 1500) -> None:
+        """One BFS level per call (level-synchronous super-step)."""
+        import jax.numpy as jnp
+
+        if self._target_reached or self._exhausted:
+            return
+        if all(name in self._found_names for name in self._prop_names) and self._P > 0:
+            return
+        if self._frontier_count == 0:
+            self._exhausted = True
+            return
+        # Depth bookkeeping mirrors the dequeue-time update (bfs.rs:257-265);
+        # a frontier at the target depth is skipped, not expanded
+        # (bfs.rs:267-272).
+        self._max_depth = max(self._max_depth, self._depth)
+        if self._target_max_depth is not None and self._depth >= self._target_max_depth:
+            self._frontier_count = 0
+            self._exhausted = True
+            return
+
+        if self._visitor is not None:
+            self._visit_frontier()
+
+        while True:  # retried only on capacity growth
+            fn = self._superstep_for(self._frontier_capacity)
+            out = fn(
+                self._frontier,
+                self._frontier_ebits,
+                self._frontier_count,
+                self._table,
+                self._disc_found,
+                self._disc_fp,
+            )
+            (nf, ne, ncount, table, dfound, dfp, d_states, d_unique, t_ovf, f_ovf) = out
+            if bool(t_ovf):
+                # Functional arrays: the pre-step table is untouched; grow
+                # and re-run the same level.
+                self._grow_table()
+                continue
+            if bool(f_ovf):
+                grown = self._frontier_capacity * 2
+                self._frontier = self._pad_rows(
+                    np.asarray(self._frontier)[: self._frontier_count], grown
+                )
+                ebits = np.zeros(grown, dtype=np.uint32)
+                ebits[: self._frontier_count] = np.asarray(self._frontier_ebits)[
+                    : self._frontier_count
+                ]
+                self._frontier_ebits = jnp.asarray(ebits)
+                self._frontier_capacity = grown
+                continue
+            break
+
+        self._frontier, self._frontier_ebits, self._table = nf, ne, table
+        self._frontier_count = int(ncount)
+        self._disc_found, self._disc_fp = dfound, dfp
+        self._state_count += int(d_states)
+        self._unique_count += int(d_unique)
+        self._depth += 1
+        # Pin first-found witnesses by name.
+        found = np.asarray(self._disc_found)
+        fps = np.asarray(self._disc_fp)
+        for i, name in enumerate(self._prop_names):
+            if found[i] and name not in self._found_names:
+                self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
+        if (
+            self._target_state_count is not None
+            and self._state_count >= self._target_state_count
+        ):
+            self._target_reached = True
+
+    def _visit_frontier(self) -> None:
+        """Applies the visitor to every frontier state's path (the XLA
+        analogue of bfs.rs:274-276). Host-side and slow; meant for small
+        runs and debugging."""
+        rows = np.asarray(self._frontier)[: self._frontier_count]
+        parents = self._parent_map()
+        for row in rows:
+            fp = fphash.fingerprint_u64(self._dedup_words_host(row[None, :])[0], np)
+            self._visitor.visit(self._model, self._path_for(fp, parents))
+
+    # --- Checker API -------------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return self._unique_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def is_done(self) -> bool:
+        if self._exhausted or self._target_reached:
+            return True
+        if self._P > 0 and all(n in self._found_names for n in self._prop_names):
+            return True
+        return self._frontier_count == 0 and self._state_count > 0
+
+    def discoveries(self) -> Dict[str, Path]:
+        parents = self._parent_map()
+        return {
+            name: self._path_for(fp64, parents)
+            for name, fp64 in self._found_names.items()
+        }
+
+    def _parent_map(self) -> Dict[int, int]:
+        """Pulls the device table once and builds fp64 -> parent fp64."""
+        kh = np.asarray(self._table.key_hi, dtype=np.uint64)
+        kl = np.asarray(self._table.key_lo, dtype=np.uint64)
+        vh = np.asarray(self._table.val_hi, dtype=np.uint64)
+        vl = np.asarray(self._table.val_lo, dtype=np.uint64)
+        occ = (kh != 0) | (kl != 0)
+        keys = (kh[occ] << np.uint64(32)) | kl[occ]
+        vals = (vh[occ] << np.uint64(32)) | vl[occ]
+        return {int(k): int(v) for k, v in zip(keys, vals)}
+
+    def _path_for(self, fp64: int, parents: Dict[int, int]) -> Path:
+        """Walks parent fingerprints back to an init state, then re-executes
+        the object model forward (bfs.rs:430-459 + path.rs:20-97, with the
+        packed fingerprint as the digest)."""
+        chain: List[int] = []
+        cur = fp64
+        while cur != 0:
+            chain.append(cur)
+            if cur not in parents:
+                raise RuntimeError(
+                    f"fingerprint {cur:#x} missing from the visited table during "
+                    "path reconstruction; packed model host/device codecs disagree"
+                )
+            cur = parents[cur]
+        chain.reverse()
+
+        model = self._model
+        last_state = None
+        for s in model.init_states():
+            if self._packed_fp64(s) == chain[0]:
+                last_state = s
+                break
+        if last_state is None:
+            raise RuntimeError(
+                "No init state matches the first fingerprint of a discovery "
+                "path. The packed codec (pack/packed_init) and the object "
+                "model disagree, or packed_step diverges from next_state."
+            )
+        pairs = []
+        for next_fp in chain[1:]:
+            found = None
+            for action, state in model.next_steps(last_state):
+                if self._packed_fp64(state) == next_fp:
+                    found = (action, state)
+                    break
+            if found is None:
+                raise RuntimeError(
+                    f"No successor of {last_state!r} matches fingerprint "
+                    f"{next_fp:#x}: packed_step and next_state disagree."
+                )
+            pairs.append((last_state, found[0]))
+            last_state = found[1]
+        pairs.append((last_state, None))
+        return Path(pairs)

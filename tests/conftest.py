@@ -1,18 +1,28 @@
 """Test configuration.
 
-Sets up a virtual 8-device CPU mesh before JAX is imported anywhere, so the
-multi-chip sharding paths are testable without TPU hardware, and makes the
-repo root importable.
+Tests always run on CPU with a virtual 8-device mesh so multi-chip sharding
+paths are exercised without TPU hardware.
+
+Environment note: the container's sitecustomize imports jax and registers the
+axon TPU plugin at interpreter start, and its register() forces
+``jax_platforms="axon,cpu"`` at the *config* level — so the ``JAX_PLATFORMS``
+env var alone cannot select CPU, and initializing the axon backend can block
+for minutes on the tunnel.  ``jax.config.update`` wins over both, and
+``XLA_FLAGS`` is read at CPU-client init, so setting it here (before any
+backend init) still works.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

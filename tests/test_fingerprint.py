@@ -1,0 +1,47 @@
+"""Stable-fingerprint unit tests (reference contract: lib.rs:327-336 fixed-key
+hashing; util.rs:134-156 order-insensitive container hashing)."""
+
+from stateright_tpu import fingerprint
+
+
+def test_stability_and_distinctness():
+    assert fingerprint((1, 2)) == fingerprint((1, 2))
+    assert fingerprint((1, 2)) != fingerprint((2, 1))
+    assert fingerprint(0) != 0  # nonzero contract
+    # Types don't collide structurally.
+    assert fingerprint(1) != fingerprint("1") != fingerprint((1,))
+    assert fingerprint(True) != fingerprint(1)
+    assert fingerprint([1, 2]) != fingerprint((1, 2))
+
+
+def test_order_insensitive_containers():
+    assert fingerprint({1, 2, 3}) == fingerprint({3, 1, 2})
+    assert fingerprint(frozenset({1, 2})) == fingerprint({2, 1})
+    assert fingerprint({"a": 1, "b": 2}) == fingerprint({"b": 2, "a": 1})
+    assert fingerprint({1, 2}) != fingerprint({1, 2, 3})
+
+
+def test_large_and_negative_ints_do_not_collide_mod_2_64():
+    assert fingerprint(0) != fingerprint(1 << 64)
+    assert fingerprint(-1) != fingerprint((1 << 64) - 1)
+    assert fingerprint(1 << 200) != fingerprint(1 << 201)
+    assert fingerprint(-(1 << 70)) != fingerprint(1 << 70)
+
+
+def test_dataclasses_and_enums():
+    from dataclasses import dataclass
+    from enum import Enum
+
+    @dataclass(frozen=True)
+    class S:
+        x: int
+        y: tuple
+
+    class E(Enum):
+        A = 1
+        B = 2
+
+    assert fingerprint(S(1, (2,))) == fingerprint(S(1, (2,)))
+    assert fingerprint(S(1, (2,))) != fingerprint(S(2, (2,)))
+    assert fingerprint(E.A) != fingerprint(E.B)
+    assert fingerprint(E.A) != fingerprint(1)
