@@ -90,8 +90,14 @@ def _digest(value: Any, acc: int) -> int:
         return _hash_bytes(_fold(acc, _T_STR), value.encode("utf-8"))
     if t is bytes:
         return _hash_bytes(_fold(acc, _T_BYTES), value)
-    if t is tuple:
+    if isinstance(value, tuple) and not hasattr(value, "__fingerprint_key__"):
+        # Tuple subclasses (NamedTuples) are tagged with the class name so
+        # e.g. Ping(0) and Pong(0) fingerprint differently, like Rust enum
+        # variants hashing their discriminant. A __fingerprint_key__ hook
+        # takes precedence (handled below).
         acc = _fold(acc, _T_TUPLE)
+        if t is not tuple:
+            acc = _hash_bytes(acc, t.__qualname__.encode("utf-8"))
         for item in value:
             acc = _digest(item, acc)
         return _fold(acc, len(value))

@@ -1,0 +1,14 @@
+"""Utility containers: rewrite plans for symmetry reduction, dense maps,
+vector clocks (reference layer L0, ``/root/reference/src/util.rs``).
+
+The reference's ``HashableHashSet``/``HashableHashMap`` (order-insensitive
+stable hashing, util.rs:73-366) have no separate classes here: plain
+``frozenset``/``dict`` values already fingerprint order-insensitively via
+``stateright_tpu.fingerprint``.
+"""
+
+from .densenatmap import DenseNatMap
+from .rewrite_plan import RewritePlan, rewrite
+from .vector_clock import VectorClock
+
+__all__ = ["DenseNatMap", "RewritePlan", "VectorClock", "rewrite"]

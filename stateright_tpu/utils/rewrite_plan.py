@@ -1,0 +1,96 @@
+"""Symmetry-reduction rewrite plans.
+
+Mirrors ``/root/reference/src/checker/rewrite_plan.rs`` and ``rewrite.rs``:
+a :class:`RewritePlan` is a permutation derived by (stably) sorting values;
+``reindex`` permutes index-keyed collections and :func:`rewrite` recursively
+remaps :class:`~stateright_tpu.actor.Id` values inside arbitrary structures.
+
+The reference implements ``Rewrite`` as a trait with blanket impls
+(rewrite.rs:24-163); here one generic function dispatches structurally, and
+classes may define ``__rewrite__(plan)`` for custom behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from ..fingerprint import fingerprint
+
+
+class RewritePlan:
+    """A permutation plan: ``order[new_index] = old_index``."""
+
+    def __init__(self, order: Sequence[int]):
+        self.order = list(order)
+        # Inverse: new index of each old index.
+        self.new_of_old = [0] * len(self.order)
+        for new, old in enumerate(self.order):
+            self.new_of_old[old] = new
+
+    @staticmethod
+    def from_values_to_sort(values: Sequence[Any]) -> "RewritePlan":
+        """Plan that would stably sort ``values`` ascending
+        (rewrite_plan.rs:81-106).  Values without a total order fall back to
+        sorting by stable fingerprint (deterministic across runs)."""
+        idx = range(len(values))
+        try:
+            order = sorted(idx, key=lambda i: values[i])
+        except TypeError:
+            order = sorted(idx, key=lambda i: fingerprint(values[i]))
+        return RewritePlan(order)
+
+    def rewrite_id(self, id_value: int):
+        """The new index of old index ``id_value`` (rewrite_plan.rs:110)."""
+        from ..actor import Id
+
+        return Id(self.new_of_old[int(id_value)])
+
+    def reindex(self, collection: Sequence[Any]) -> List[Any]:
+        """Permutes an index-keyed collection (rewrite_plan.rs:118-123)."""
+        return [collection[old] for old in self.order]
+
+
+def rewrite(value: Any, plan: RewritePlan) -> Any:
+    """Recursively remaps :class:`Id` values inside ``value``
+    (the generic analogue of rewrite.rs's blanket impls: no-op for scalars,
+    structural recursion for containers, ``__rewrite__`` for custom types).
+    Unknown structured types raise rather than silently passing through —
+    a missed Id remap would make symmetry reduction unsound."""
+    import dataclasses
+    from enum import Enum
+
+    from ..actor import Id
+    from ..actor.network import Envelope
+
+    if isinstance(value, Id):
+        return plan.rewrite_id(value)
+    custom = getattr(value, "__rewrite__", None)
+    if custom is not None:
+        return custom(plan)
+    if isinstance(value, Envelope):
+        return Envelope(
+            rewrite(value.src, plan), rewrite(value.dst, plan), rewrite(value.msg, plan)
+        )
+    t = type(value)
+    if t is tuple or (isinstance(value, tuple) and hasattr(value, "_fields")):
+        items = [rewrite(v, plan) for v in value]
+        return t(*items) if hasattr(value, "_fields") else tuple(items)
+    if t is list:
+        return [rewrite(v, plan) for v in value]
+    if t in (set, frozenset):
+        return t(rewrite(v, plan) for v in value)
+    if t is dict:
+        return {rewrite(k, plan): rewrite(v, plan) for k, v in value.items()}
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return type(value)(
+            **{
+                f.name: rewrite(getattr(value, f.name), plan)
+                for f in dataclasses.fields(value)
+            }
+        )
+    if value is None or isinstance(value, (bool, int, float, str, bytes, Enum)):
+        return value
+    raise TypeError(
+        f"Cannot rewrite value of type {t.__qualname__} for symmetry "
+        f"reduction: define a __rewrite__(plan) method."
+    )
