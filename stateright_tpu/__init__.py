@@ -28,6 +28,13 @@ from .checker import (
     StateRecorder,
 )
 from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
+from .semantics import (
+    ConsistencyTester,
+    HistoryError,
+    LinearizabilityTester,
+    SequentialConsistencyTester,
+    SequentialSpec,
+)
 
 __version__ = "0.1.0"
 
@@ -35,7 +42,12 @@ __all__ = [
     "Checker",
     "CheckerBuilder",
     "CheckerVisitor",
+    "ConsistencyTester",
     "Expectation",
+    "HistoryError",
+    "LinearizabilityTester",
+    "SequentialConsistencyTester",
+    "SequentialSpec",
     "Model",
     "NondeterministicModelError",
     "Path",

@@ -33,25 +33,57 @@ def deserialize_json(data: bytes) -> Any:
 
 
 def json_codec(*msg_types: type):
-    """Builds a ``(serialize, deserialize)`` pair that tags each message
-    with its class name and reconstructs the class on receive — so typed
-    messages (NamedTuples) survive the wire like serde's tagged enums.
+    """Builds a ``(serialize, deserialize)`` pair that tags values with
+    their class name *recursively* and reconstructs them on receive — so
+    typed messages (NamedTuples), including nested ones and tuple/set/dict
+    payloads, survive the wire like serde's tagged enums.
 
-    ``msg_types`` are the NamedTuple classes the actors exchange; untyped
-    JSON-compatible payloads pass through untagged.
+    ``msg_types`` are the NamedTuple classes the actors exchange; scalars
+    and lists pass through untagged.
     """
     by_name = {t.__name__: t for t in msg_types}
 
+    def _enc(v: Any) -> Any:
+        t = type(v)
+        if t.__name__ in by_name and isinstance(v, tuple):
+            return {"@": t.__name__, "f": [_enc(x) for x in v]}
+        if t is tuple:
+            return {"@": "__tuple__", "f": [_enc(x) for x in v]}
+        if t in (set, frozenset):
+            tag = "__set__" if t is set else "__frozenset__"
+            return {"@": tag, "f": [_enc(x) for x in v]}
+        if t is dict:
+            return {"@": "__dict__", "f": [[_enc(k), _enc(x)] for k, x in v.items()]}
+        if t is list:
+            return [_enc(x) for x in v]
+        if v is None or t in (bool, int, float, str):
+            return v
+        raise TypeError(
+            f"json_codec cannot serialize {t.__qualname__}; register the "
+            f"class or use a custom serialize fn"
+        )
+
+    def _dec(v: Any) -> Any:
+        if isinstance(v, list):
+            return [_dec(x) for x in v]
+        if isinstance(v, dict):
+            tag, fields = v["@"], v["f"]
+            if tag == "__tuple__":
+                return tuple(_dec(x) for x in fields)
+            if tag == "__set__":
+                return set(_dec(x) for x in fields)
+            if tag == "__frozenset__":
+                return frozenset(_dec(x) for x in fields)
+            if tag == "__dict__":
+                return {_dec(k): _dec(x) for k, x in fields}
+            return by_name[tag](*(_dec(x) for x in fields))
+        return v
+
     def serialize(msg: Any) -> bytes:
-        if type(msg).__name__ in by_name:
-            return json.dumps([type(msg).__name__, list(msg)]).encode("utf-8")
-        return json.dumps(["", msg]).encode("utf-8")
+        return json.dumps(_enc(msg)).encode("utf-8")
 
     def deserialize(data: bytes) -> Any:
-        tag, payload = json.loads(data.decode("utf-8"))
-        if tag:
-            return by_name[tag](*payload)
-        return payload
+        return _dec(json.loads(data.decode("utf-8")))
 
     return serialize, deserialize
 

@@ -46,8 +46,10 @@ class RewritePlan:
         return Id(self.new_of_old[int(id_value)])
 
     def reindex(self, collection: Sequence[Any]) -> List[Any]:
-        """Permutes an index-keyed collection (rewrite_plan.rs:118-123)."""
-        return [collection[old] for old in self.order]
+        """Permutes an index-keyed collection AND rewrites each element
+        (rewrite_plan.rs:118-123 rewrites every element as it permutes —
+        element values may themselves embed Ids that must be remapped)."""
+        return [rewrite(collection[old], self) for old in self.order]
 
 
 def rewrite(value: Any, plan: RewritePlan) -> Any:

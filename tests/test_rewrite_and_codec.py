@@ -1,0 +1,60 @@
+"""Regression tests for symmetry rewrite plans and the UDP wire codec."""
+
+from stateright_tpu.actor import Id
+from stateright_tpu.actor.spawn import json_codec
+from stateright_tpu.utils.rewrite_plan import RewritePlan, rewrite
+
+
+class TestRewritePlan:
+    def test_reindex_rewrites_elements(self):
+        # Mirrors rewrite_plan.rs:118-123: reindex permutes AND rewrites.
+        # "Each actor points at its peer": state[i] holds the peer's Id.
+        # Under the swap permutation the canonical form must still point at
+        # the peer — not collapse onto "each points at itself".
+        plan = RewritePlan([1, 0])  # swap actors 0 and 1
+        pointing_at_peer = [Id(1), Id(0)]
+        assert plan.reindex(pointing_at_peer) == [Id(1), Id(0)]
+        pointing_at_self = [Id(0), Id(1)]
+        assert plan.reindex(pointing_at_self) == [Id(0), Id(1)]
+        # The two non-equivalent states stay distinguishable.
+        assert plan.reindex(pointing_at_peer) != plan.reindex(pointing_at_self)
+
+    def test_reindex_permutes(self):
+        plan = RewritePlan([2, 0, 1])
+        assert plan.reindex(["c", "a", "b"]) == ["b", "c", "a"]
+
+    def test_rewrite_nested(self):
+        plan = RewritePlan([1, 0])
+        value = {("x", Id(0)): [Id(1), frozenset({Id(0)})]}
+        assert rewrite(value, plan) == {("x", Id(1)): [Id(0), frozenset({Id(1)})]}
+
+
+class TestJsonCodec:
+    def test_nested_named_tuples_round_trip(self):
+        from typing import Any, NamedTuple
+
+        class Ping(NamedTuple):
+            n: int
+
+        class Req(NamedTuple):
+            inner: Any
+
+        ser, de = json_codec(Ping, Req)
+        msg = Req(Ping(0))
+        assert de(ser(msg)) == msg
+        assert isinstance(de(ser(msg)).inner, Ping)
+
+    def test_tuple_set_dict_payloads_round_trip(self):
+        ser, de = json_codec()
+        for msg in [
+            ("ack", 1),
+            {"k": (1, 2), 3: "v"},
+            frozenset({1, 2}),
+            {1, 2},
+            [1, ("a", None)],
+            "plain",
+            7,
+            None,
+        ]:
+            got = de(ser(msg))
+            assert got == msg and type(got) is type(msg)

@@ -1,0 +1,358 @@
+"""Semantics-layer tests, porting the reference's cases:
+
+- spec objects: semantics/register.rs:51-87, vec.rs:52-99,
+  write_once_register.rs:60-113
+- linearizability: semantics/linearizability.rs:314-513
+- sequential consistency: semantics/sequential_consistency.rs:270-379
+"""
+
+import pytest
+
+from stateright_tpu.semantics import (
+    HistoryError,
+    LinearizabilityTester,
+    SequentialConsistencyTester,
+)
+from stateright_tpu.semantics.register import Read, ReadOk, Register, Write, WriteOk
+from stateright_tpu.semantics import vec
+from stateright_tpu.semantics import write_once_register as wor
+from stateright_tpu.semantics.vec import Len, LenOk, Pop, PopOk, Push, PushOk, VecSpec
+
+
+class TestRegisterSpec:
+    def test_models_expected_semantics(self):
+        r = Register("A")
+        assert r.invoke(Read()) == ReadOk("A")
+        assert r.invoke(Write("B")) == WriteOk()
+        assert r.invoke(Read()) == ReadOk("B")
+
+    def test_accepts_valid_histories(self):
+        assert Register("A").is_valid_history([])
+        assert Register("A").is_valid_history(
+            [
+                (Read(), ReadOk("A")),
+                (Write("B"), WriteOk()),
+                (Read(), ReadOk("B")),
+                (Write("C"), WriteOk()),
+                (Read(), ReadOk("C")),
+            ]
+        )
+
+    def test_rejects_invalid_histories(self):
+        assert not Register("A").is_valid_history(
+            [(Read(), ReadOk("B")), (Write("B"), WriteOk())]
+        )
+        assert not Register("A").is_valid_history(
+            [(Write("B"), WriteOk()), (Read(), ReadOk("A"))]
+        )
+
+
+class TestVecSpec:
+    def test_models_expected_semantics(self):
+        v = VecSpec(("A",))
+        assert v.invoke(Len()) == LenOk(1)
+        assert v.invoke(Push("B")) == PushOk()
+        assert v.invoke(Len()) == LenOk(2)
+        assert v.invoke(Pop()) == PopOk("B")
+        assert v.invoke(Len()) == LenOk(1)
+        assert v.invoke(Pop()) == PopOk("A")
+        assert v.invoke(Len()) == LenOk(0)
+        assert v.invoke(Pop()) == PopOk(None)
+
+    def test_accepts_valid_histories(self):
+        assert VecSpec().is_valid_history([])
+        assert VecSpec().is_valid_history(
+            [
+                (Push(10), PushOk()),
+                (Push(20), PushOk()),
+                (Len(), LenOk(2)),
+                (Pop(), PopOk(20)),
+                (Len(), LenOk(1)),
+                (Pop(), PopOk(10)),
+                (Len(), LenOk(0)),
+                (Pop(), PopOk(None)),
+            ]
+        )
+
+    def test_rejects_invalid_histories(self):
+        assert not VecSpec().is_valid_history(
+            [(Push(10), PushOk()), (Push(20), PushOk()), (Len(), LenOk(1))]
+        )
+        assert not VecSpec().is_valid_history(
+            [(Push(10), PushOk()), (Push(20), PushOk()), (Pop(), PopOk(10))]
+        )
+
+
+class TestWORegisterSpec:
+    def test_models_expected_semantics(self):
+        r = wor.WORegister(None)
+        assert r.invoke(wor.Write("A")) == wor.WriteOk()
+        assert r.invoke(wor.Read()) == wor.ReadOk("A")
+        assert r.invoke(wor.Write("B")) == wor.WriteFail()
+        assert r.invoke(wor.Read()) == wor.ReadOk("A")
+
+    def test_accepts_valid_histories(self):
+        assert wor.WORegister(None).is_valid_history([])
+        assert wor.WORegister(None).is_valid_history(
+            [
+                (wor.Read(), wor.ReadOk(None)),
+                (wor.Write("A"), wor.WriteOk()),
+                (wor.Read(), wor.ReadOk("A")),
+                (wor.Write("B"), wor.WriteFail()),
+                (wor.Read(), wor.ReadOk("A")),
+                (wor.Write("C"), wor.WriteFail()),
+                (wor.Read(), wor.ReadOk("A")),
+            ]
+        )
+
+    def test_rejects_invalid_histories(self):
+        assert not wor.WORegister("A").is_valid_history(
+            [(wor.Read(), wor.ReadOk("A")), (wor.Write("B"), wor.WriteOk())]
+        )
+        assert not wor.WORegister(None).is_valid_history(
+            [(wor.Read(), wor.ReadOk("A")), (wor.Write("A"), wor.WriteOk())]
+        )
+        assert not wor.WORegister(None).is_valid_history(
+            [
+                (wor.Read(), wor.ReadOk(None)),
+                (wor.Write("A"), wor.WriteOk()),
+                (wor.Write("B"), wor.WriteOk()),
+            ]
+        )
+
+
+class TestLinearizability:
+    def test_rejects_invalid_history(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(99, Write("B"))
+        with pytest.raises(HistoryError):
+            t.on_invoke(99, Write("C"))
+        assert not t.is_consistent()
+
+        t = LinearizabilityTester(Register("A"))
+        t.on_invret(99, Write("B"), WriteOk()).on_invret(99, Write("C"), WriteOk())
+        with pytest.raises(HistoryError):
+            t.on_return(99, WriteOk())
+        assert not t.is_consistent()
+
+    def test_identifies_linearizable_register_history(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(0, Write("B"))
+        t.on_invret(1, Read(), ReadOk("A"))
+        assert t.serialized_history() == [(Read(), ReadOk("A"))]
+
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(0, Read())
+        t.on_invoke(1, Write("B"))
+        t.on_return(0, ReadOk("B"))
+        assert t.serialized_history() == [
+            (Write("B"), WriteOk()),
+            (Read(), ReadOk("B")),
+        ]
+
+    def test_identifies_unlinearizable_register_history(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invret(0, Read(), ReadOk("B"))
+        assert t.serialized_history() is None
+
+        # SC but not linearizable: the read completed before the write began.
+        t = LinearizabilityTester(Register("A"))
+        t.on_invret(0, Read(), ReadOk("B"))
+        t.on_invoke(1, Write("B"))
+        assert t.serialized_history() is None
+
+    def test_identifies_linearizable_vec_history(self):
+        t = LinearizabilityTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        assert t.serialized_history() == []
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        t.on_invret(1, Pop(), PopOk(None))
+        assert t.serialized_history() == [(Pop(), PopOk(None))]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        t.on_invret(1, Pop(), PopOk(10))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Pop(), PopOk(10)),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, Len(), LenOk(1))
+        t.on_invret(1, Pop(), PopOk(20))
+        t.on_invret(1, Pop(), PopOk(10))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Len(), LenOk(1)),
+            (Push(20), PushOk()),
+            (Pop(), PopOk(20)),
+            (Pop(), PopOk(10)),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, Len(), LenOk(1))
+        t.on_invret(1, Pop(), PopOk(10))
+        t.on_invret(1, Pop(), PopOk(20))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Len(), LenOk(1)),
+            (Pop(), PopOk(10)),
+            (Push(20), PushOk()),
+            (Pop(), PopOk(20)),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, Len(), LenOk(2))
+        t.on_invret(1, Pop(), PopOk(20))
+        t.on_invret(1, Pop(), PopOk(10))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Push(20), PushOk()),
+            (Len(), LenOk(2)),
+            (Pop(), PopOk(20)),
+            (Pop(), PopOk(10)),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(1, Len())
+        t.on_invoke(0, Push(20))
+        t.on_return(1, LenOk(1))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Len(), LenOk(1)),
+        ]
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(1, Len())
+        t.on_invoke(0, Push(20))
+        t.on_return(1, LenOk(2))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Push(20), PushOk()),
+            (Len(), LenOk(2)),
+        ]
+
+    def test_identifies_unlinearizable_vec_history(self):
+        # SC but not linearizable.
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invret(1, Pop(), PopOk(None))
+        assert t.serialized_history() is None
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(1, Len())
+        t.on_invoke(0, Push(20))
+        t.on_return(1, LenOk(0))
+        assert t.serialized_history() is None
+
+        t = LinearizabilityTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, Len(), LenOk(2))
+        t.on_invret(1, Pop(), PopOk(10))
+        t.on_invret(1, Pop(), PopOk(20))
+        assert t.serialized_history() is None
+
+
+class TestSequentialConsistency:
+    def test_rejects_invalid_history(self):
+        t = SequentialConsistencyTester(Register("A"))
+        t.on_invoke(99, Write("B"))
+        with pytest.raises(HistoryError):
+            t.on_invoke(99, Write("C"))
+        assert not t.is_consistent()
+
+    def test_identifies_serializable_register_history(self):
+        t = SequentialConsistencyTester(Register("A"))
+        t.on_invoke(0, Write("B"))
+        t.on_invret(1, Read(), ReadOk("A"))
+        assert t.serialized_history() == [(Read(), ReadOk("A"))]
+
+        # Not linearizable, but SC: thread 1's write serializes first.
+        t = SequentialConsistencyTester(Register("A"))
+        t.on_invret(0, Read(), ReadOk("B"))
+        t.on_invoke(1, Write("B"))
+        assert t.serialized_history() == [
+            (Write("B"), WriteOk()),
+            (Read(), ReadOk("B")),
+        ]
+
+    def test_identifies_unserializable_register_history(self):
+        t = SequentialConsistencyTester(Register("A"))
+        t.on_invret(0, Read(), ReadOk("B"))
+        assert t.serialized_history() is None
+
+    def test_identifies_serializable_vec_history(self):
+        t = SequentialConsistencyTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        assert t.serialized_history() == []
+
+        t = SequentialConsistencyTester(VecSpec())
+        t.on_invoke(0, Push(10))
+        t.on_invret(1, Pop(), PopOk(None))
+        assert t.serialized_history() == [(Pop(), PopOk(None))]
+
+        t = SequentialConsistencyTester(VecSpec())
+        t.on_invret(1, Pop(), PopOk(10))
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invret(0, Pop(), PopOk(20))
+        t.on_invoke(0, Push(30))
+        t.on_invret(1, Push(20), PushOk())
+        t.on_invret(1, Pop(), PopOk(None))
+        assert t.serialized_history() == [
+            (Push(10), PushOk()),
+            (Pop(), PopOk(10)),
+            (Push(20), PushOk()),
+            (Pop(), PopOk(20)),
+            (Pop(), PopOk(None)),
+        ]
+
+    def test_identifies_unserializable_vec_history(self):
+        t = SequentialConsistencyTester(VecSpec())
+        t.on_invret(0, Push(10), PushOk())
+        t.on_invoke(0, Push(20))
+        t.on_invret(1, Len(), LenOk(2))
+        t.on_invret(1, Pop(), PopOk(10))
+        t.on_invret(1, Pop(), PopOk(20))
+        assert t.serialized_history() is None
+
+
+class TestTesterValueSemantics:
+    """Testers ride in fingerprinted ActorModel history state, so they need
+    clone/eq/hash value semantics (the reference derives Clone/Hash/Eq)."""
+
+    def test_clone_is_independent(self):
+        t = LinearizabilityTester(Register("A"))
+        t.on_invoke(0, Write("B"))
+        dup = t.clone()
+        dup.on_return(0, WriteOk())
+        assert len(t) == 1 and len(dup) == 1
+        assert t != dup
+        assert t.in_flight_by_thread and not dup.in_flight_by_thread
+
+    def test_eq_and_hash(self):
+        def build():
+            t = SequentialConsistencyTester(VecSpec())
+            t.on_invret(0, Push(1), PushOk())
+            t.on_invoke(1, Pop())
+            return t
+
+        a, b = build(), build()
+        assert a == b and hash(a) == hash(b)
+
+        from stateright_tpu.fingerprint import fingerprint
+
+        assert fingerprint(a) == fingerprint(b)
+        b.on_return(1, PopOk(1))
+        assert fingerprint(a) != fingerprint(b)
