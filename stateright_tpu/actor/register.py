@@ -1,0 +1,152 @@
+"""Register protocol interface + test client for model checking.
+
+Mirrors ``/root/reference/src/actor/register.rs``: a message protocol for
+register-like systems (``Put``/``Get``/``PutOk``/``GetOk`` + ``Internal``),
+glue that records those messages as consistency-tester invocations/returns
+(register.rs:38-91), and a scripted client that Puts then Gets round-robin
+across servers (register.rs:94-260).
+
+Design delta: Rust wraps servers in ``RegisterActor::Server`` so one enum
+covers both roles; under duck typing servers are added to the model directly
+and the client is the plain :class:`RegisterClient` actor — so server states
+appear unwrapped in ``actor_states``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+from ..semantics import HistoryError
+from ..semantics.register import Read as RegisterRead
+from ..semantics.register import ReadOk as RegisterReadOk
+from ..semantics.register import Write as RegisterWrite
+from ..semantics.register import WriteOk as RegisterWriteOk
+
+
+class Internal(NamedTuple):
+    """A message specific to the register system's internal protocol."""
+
+    msg: Any
+
+
+class Put(NamedTuple):
+    request_id: int
+    value: Any
+
+
+class Get(NamedTuple):
+    request_id: int
+
+
+class PutOk(NamedTuple):
+    request_id: int
+
+
+class GetOk(NamedTuple):
+    request_id: int
+    value: Any
+
+
+def record_invocations(cfg, history, env):
+    """Pass to ``ActorModel.record_msg_out``: ``Get``→``Read`` invocation,
+    ``Put``→``Write`` invocation by the sending client (register.rs:38-62).
+    Invalid histories poison the tester rather than crash the check."""
+    if isinstance(env.msg, Get):
+        history = history.clone()
+        try:
+            history.on_invoke(env.src, RegisterRead())
+        except HistoryError:
+            pass
+        return history
+    if isinstance(env.msg, Put):
+        history = history.clone()
+        try:
+            history.on_invoke(env.src, RegisterWrite(env.msg.value))
+        except HistoryError:
+            pass
+        return history
+    return None
+
+
+def record_returns(cfg, history, env):
+    """Pass to ``ActorModel.record_msg_in``: ``GetOk``→``ReadOk`` return,
+    ``PutOk``→``WriteOk`` return to the receiving client (register.rs:64-91)."""
+    if isinstance(env.msg, GetOk):
+        history = history.clone()
+        try:
+            history.on_return(env.dst, RegisterReadOk(env.msg.value))
+        except HistoryError:
+            pass
+        return history
+    if isinstance(env.msg, PutOk):
+        history = history.clone()
+        try:
+            history.on_return(env.dst, RegisterWriteOk())
+        except HistoryError:
+            pass
+        return history
+    return None
+
+
+class ClientState(NamedTuple):
+    awaiting: Optional[int]
+    op_count: int
+
+
+class RegisterClient:
+    """A test client that performs ``put_count`` Puts, then one Get,
+    round-robin across the servers (register.rs:94-260).
+
+    Assumes servers occupy indices ``0..server_count`` so a server id is
+    derivable as ``(client_index + k) % server_count`` (register.rs:118-120).
+    Request ids are ``op_count * client_index``, unique per (client, op)
+    because client indices exceed ``server_count >= 1``.
+    """
+
+    def __init__(self, put_count: int, server_count: int):
+        self.put_count = put_count
+        self.server_count = server_count
+
+    def on_start(self, id, out):
+        from . import Id
+
+        index = int(id)
+        if index < self.server_count:
+            raise ValueError(
+                "RegisterClient actors must be added to the model after servers."
+            )
+        if self.put_count == 0:
+            return ClientState(awaiting=None, op_count=0)
+        unique_request_id = 1 * index  # next will be 2 * index
+        value = chr(ord("A") + index - self.server_count)
+        out.send(Id(index % self.server_count), Put(unique_request_id, value))
+        return ClientState(awaiting=unique_request_id, op_count=1)
+
+    def on_msg(self, id, state, src, msg, out):
+        from . import Id
+
+        current = state.get()
+        if current.awaiting is None:
+            return
+        index = int(id)
+        if isinstance(msg, PutOk) and msg.request_id == current.awaiting:
+            unique_request_id = (current.op_count + 1) * index
+            if current.op_count < self.put_count:
+                value = chr(ord("Z") - (index - self.server_count))
+                out.send(
+                    Id((index + current.op_count) % self.server_count),
+                    Put(unique_request_id, value),
+                )
+            else:
+                out.send(
+                    Id((index + current.op_count) % self.server_count),
+                    Get(unique_request_id),
+                )
+            state.set(
+                ClientState(awaiting=unique_request_id, op_count=current.op_count + 1)
+            )
+        elif isinstance(msg, GetOk) and msg.request_id == current.awaiting:
+            state.set(ClientState(awaiting=None, op_count=current.op_count + 1))
+
+    def on_timeout(self, id, state, timer, out):
+        pass

@@ -1,0 +1,99 @@
+"""Ordered-reliable-link tests, porting ordered_reliable_link.rs:207-316:
+under a lossy duplicating network (bounded to <4 in-flight messages) the ORL
+must prevent redelivery, preserve order, and be able to deliver."""
+
+from typing import NamedTuple
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import ActorModel, DeliverAction, Id, Network
+from stateright_tpu.actor.ordered_reliable_link import (
+    ActorWrapper,
+    Deliver,
+)
+
+
+class OrlMsg(NamedTuple):
+    value: int
+
+
+class Sender:
+    def __init__(self, receiver_id):
+        self.receiver_id = receiver_id
+
+    def on_start(self, id, out):
+        out.send(self.receiver_id, OrlMsg(42))
+        out.send(self.receiver_id, OrlMsg(43))
+        return ()
+
+    def on_msg(self, id, state, src, msg, out):
+        pass
+
+    def on_timeout(self, id, state, timer, out):
+        pass
+
+
+class Receiver:
+    def on_start(self, id, out):
+        return ()
+
+    def on_msg(self, id, state, src, msg, out):
+        state.set(state.get() + ((src, msg),))
+
+    def on_timeout(self, id, state, timer, out):
+        pass
+
+
+def _received(state):
+    return state.actor_states[1].wrapped_state
+
+
+def model():
+    return (
+        ActorModel(cfg=None, init_history=())
+        .actor(ActorWrapper.with_default_timeout(Sender(Id(1))))
+        .actor(ActorWrapper.with_default_timeout(Receiver()))
+        .init_network(Network.new_unordered_duplicating())
+        .lossy_network(True)
+        .property(
+            Expectation.ALWAYS,
+            "no redelivery",
+            lambda _, state: (
+                sum(1 for _, m in _received(state) if m.value == 42) < 2
+                and sum(1 for _, m in _received(state) if m.value == 43) < 2
+            ),
+        )
+        .property(
+            Expectation.ALWAYS,
+            "ordered",
+            lambda _, state: all(
+                a.value <= b.value
+                for (_, a), (_, b) in zip(_received(state), _received(state)[1:])
+            ),
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "delivered",
+            lambda _, state: _received(state)
+            == ((Id(0), OrlMsg(42)), (Id(0), OrlMsg(43))),
+        )
+        .within_boundary_fn(lambda _, state: len(state.network) < 4)
+    )
+
+
+def test_messages_are_not_delivered_twice():
+    model().checker().spawn_bfs().join().assert_no_discovery("no redelivery")
+
+
+def test_messages_are_delivered_in_order():
+    model().checker().spawn_bfs().join().assert_no_discovery("ordered")
+
+
+def test_messages_are_eventually_delivered():
+    checker = model().checker().spawn_bfs().join()
+    checker.assert_discovery(
+        "delivered",
+        [
+            DeliverAction(Id(0), Id(1), Deliver(1, OrlMsg(42))),
+            DeliverAction(Id(0), Id(1), Deliver(2, OrlMsg(43))),
+        ],
+    )

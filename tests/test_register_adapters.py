@@ -1,0 +1,119 @@
+"""Register / write-once-register adapter tests: a minimal in-memory server
+plus scripted clients, with consistency testers riding in the model history
+(the shape of the reference's register.rs / write_once_register.rs usage).
+
+The 93-unique-state count for 2 clients + 1 server matches the reference's
+single-copy-register example (examples/single-copy-register.rs:110), which
+uses exactly this topology.
+"""
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import ActorModel, Network
+from stateright_tpu.actor import register as reg
+from stateright_tpu.actor import write_once_register as woreg
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.register import Register
+from stateright_tpu.semantics.write_once_register import WORegister
+
+
+class SingleRegisterServer:
+    """Unreplicated register server: stores the latest Put value."""
+
+    def on_start(self, id, out):
+        return None
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, reg.Put):
+            state.set(msg.value)
+            out.send(src, reg.PutOk(msg.request_id))
+        elif isinstance(msg, reg.Get):
+            out.send(src, reg.GetOk(msg.request_id, state.get()))
+
+    def on_timeout(self, id, state, timer, out):
+        pass
+
+
+class SingleWORegisterServer:
+    """Write-once server: first Put wins, conflicting Puts fail."""
+
+    def on_start(self, id, out):
+        return None
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, woreg.Put):
+            if state.get() is None or state.get() == msg.value:
+                state.set(msg.value)
+                out.send(src, woreg.PutOk(msg.request_id))
+            else:
+                out.send(src, woreg.PutFail(msg.request_id))
+        elif isinstance(msg, woreg.Get):
+            out.send(src, woreg.GetOk(msg.request_id, state.get()))
+
+    def on_timeout(self, id, state, timer, out):
+        pass
+
+
+def test_single_server_register_is_linearizable():
+    m = (
+        ActorModel(cfg=None, init_history=LinearizabilityTester(Register(None)))
+        .actor(SingleRegisterServer())
+        .actor(reg.RegisterClient(put_count=1, server_count=1))
+        .actor(reg.RegisterClient(put_count=1, server_count=1))
+        .init_network(Network.new_unordered_nonduplicating())
+        .record_msg_out(reg.record_invocations)
+        .record_msg_in(reg.record_returns)
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda _, s: s.history.serialized_history() is not None,
+        )
+    )
+    checker = m.checker().spawn_bfs().join()
+    checker.assert_no_discovery("linearizable")
+    assert checker.unique_state_count() == 93
+
+
+def test_single_server_wo_register_is_linearizable():
+    m = (
+        ActorModel(cfg=None, init_history=LinearizabilityTester(WORegister(None)))
+        .actor(SingleWORegisterServer())
+        .actor(woreg.WORegisterClient(put_count=1, server_count=1))
+        .actor(woreg.WORegisterClient(put_count=1, server_count=1))
+        .init_network(Network.new_unordered_nonduplicating())
+        .record_msg_out(woreg.record_invocations)
+        .record_msg_in(woreg.record_returns)
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda _, s: s.history.serialized_history() is not None,
+        )
+    )
+    checker = m.checker().spawn_bfs().join()
+    checker.assert_no_discovery("linearizable")
+    assert checker.unique_state_count() > 0
+
+
+def test_client_script_shape():
+    """The client performs put_count Puts then one Get, rotating servers;
+    request ids are (op_count)*index at each step (register.rs:118-120)."""
+    from stateright_tpu.actor import Id, Out
+
+    client = reg.RegisterClient(put_count=2, server_count=2)
+    out = Out()
+    state = client.on_start(Id(3), out)
+    assert state == reg.ClientState(awaiting=3, op_count=1)
+    assert out.commands[0].dst == Id(1) and out.commands[0].msg == reg.Put(3, "B")
+
+    from stateright_tpu.actor import StateRef
+
+    ref = StateRef(state)
+    out = Out()
+    client.on_msg(Id(3), ref, Id(1), reg.PutOk(3), out)
+    assert ref.get() == reg.ClientState(awaiting=6, op_count=2)
+    assert out.commands[0].dst == Id(0) and out.commands[0].msg == reg.Put(6, "Y")
+
+    ref2 = StateRef(ref.get())
+    out = Out()
+    client.on_msg(Id(3), ref2, Id(0), reg.PutOk(6), out)
+    assert ref2.get() == reg.ClientState(awaiting=9, op_count=3)
+    assert out.commands[0].dst == Id(1) and out.commands[0].msg == reg.Get(9)
