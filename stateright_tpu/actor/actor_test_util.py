@@ -6,15 +6,13 @@ from __future__ import annotations
 from typing import NamedTuple, Optional
 
 from ..core import Expectation
+from ..utils.variant import variant
 from . import Actor, ActorModel, Id, Out, StateRef
 
-
-class Ping(NamedTuple):
-    value: int
-
-
-class Pong(NamedTuple):
-    value: int
+# variant, not NamedTuple: Ping(n) must not equal Pong(n) in the modeled
+# network (Rust enum variants never compare equal across variants).
+Ping = variant("Ping", ["value"])
+Pong = variant("Pong", ["value"])
 
 
 class PingPongActor(Actor):

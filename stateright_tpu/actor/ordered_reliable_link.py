@@ -18,39 +18,26 @@ Deltas from the reference, intentional:
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, Tuple
 
+from ..utils.variant import variant
 
-class Deliver(NamedTuple):
-    """Payload carrier: sequence number + wrapped message."""
+#: Payload carrier: sequence number + wrapped message.
+Deliver = variant("Deliver", ["seq", "msg"])
+Ack = variant("Ack", ["seq"])
+#: The periodic resend timer.
+NetworkTimer = variant("NetworkTimer", [])
+#: A timer belonging to the wrapped actor.
+UserTimer = variant("UserTimer", ["timer"])
 
-    seq: int
-    msg: Any
-
-
-class Ack(NamedTuple):
-    seq: int
-
-
-class NetworkTimer(NamedTuple):
-    """The periodic resend timer."""
-
-
-class UserTimer(NamedTuple):
-    """A timer belonging to the wrapped actor."""
-
-    timer: Any
-
-
-class LinkState(NamedTuple):
-    """ORL bookkeeping around the wrapped actor's state
-    (ordered_reliable_link.rs:50-60).  Maps are stored as sorted item
-    tuples so states stay immutable, hashable, and fingerprintable."""
-
-    next_send_seq: int
-    msgs_pending_ack: Tuple[Tuple[int, Tuple[Any, Any]], ...]  # seq -> (dst, msg)
-    last_delivered_seqs: Tuple[Tuple[Any, int], ...]  # src -> seq
-    wrapped_state: Any
+#: ORL bookkeeping around the wrapped actor's state
+#: (ordered_reliable_link.rs:50-60).  Maps are stored as sorted item tuples
+#: so states stay immutable, hashable, and fingerprintable:
+#: msgs_pending_ack is seq -> (dst, msg); last_delivered_seqs is src -> seq.
+LinkState = variant(
+    "LinkState",
+    ["next_send_seq", "msgs_pending_ack", "last_delivered_seqs", "wrapped_state"],
+)
 
 
 def _items_set(items: Tuple, key: Any, value: Any) -> Tuple:

@@ -14,37 +14,19 @@ appear unwrapped in ``actor_states``.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
-
 from ..semantics import HistoryError
 from ..semantics.register import Read as RegisterRead
 from ..semantics.register import ReadOk as RegisterReadOk
 from ..semantics.register import Write as RegisterWrite
 from ..semantics.register import WriteOk as RegisterWriteOk
+from ..utils.variant import variant
 
-
-class Internal(NamedTuple):
-    """A message specific to the register system's internal protocol."""
-
-    msg: Any
-
-
-class Put(NamedTuple):
-    request_id: int
-    value: Any
-
-
-class Get(NamedTuple):
-    request_id: int
-
-
-class PutOk(NamedTuple):
-    request_id: int
-
-
-class GetOk(NamedTuple):
-    request_id: int
-    value: Any
+#: A message specific to the register system's internal protocol.
+Internal = variant("Internal", ["msg"])
+Put = variant("Put", ["request_id", "value"])
+Get = variant("Get", ["request_id"])
+PutOk = variant("PutOk", ["request_id"])
+GetOk = variant("GetOk", ["request_id", "value"])
 
 
 def record_invocations(cfg, history, env):
@@ -88,9 +70,7 @@ def record_returns(cfg, history, env):
     return None
 
 
-class ClientState(NamedTuple):
-    awaiting: Optional[int]
-    op_count: int
+ClientState = variant("ClientState", ["awaiting", "op_count"])
 
 
 class RegisterClient:

@@ -10,40 +10,20 @@ same Put-then-Get scripted client.  Same design delta as
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
-
 from ..semantics import HistoryError
 from ..semantics.write_once_register import Read as WORead
 from ..semantics.write_once_register import ReadOk as WOReadOk
 from ..semantics.write_once_register import Write as WOWrite
 from ..semantics.write_once_register import WriteFail as WOWriteFail
 from ..semantics.write_once_register import WriteOk as WOWriteOk
+from ..utils.variant import variant
 
-
-class Internal(NamedTuple):
-    msg: Any
-
-
-class Put(NamedTuple):
-    request_id: int
-    value: Any
-
-
-class Get(NamedTuple):
-    request_id: int
-
-
-class PutOk(NamedTuple):
-    request_id: int
-
-
-class PutFail(NamedTuple):
-    request_id: int
-
-
-class GetOk(NamedTuple):
-    request_id: int
-    value: Any
+Internal = variant("Internal", ["msg"])
+Put = variant("Put", ["request_id", "value"])
+Get = variant("Get", ["request_id"])
+PutOk = variant("PutOk", ["request_id"])
+PutFail = variant("PutFail", ["request_id"])
+GetOk = variant("GetOk", ["request_id", "value"])
 
 
 def record_invocations(cfg, history, env):
@@ -93,9 +73,7 @@ def record_returns(cfg, history, env):
     return None
 
 
-class ClientState(NamedTuple):
-    awaiting: Optional[int]
-    op_count: int
+ClientState = variant("ClientState", ["awaiting", "op_count"])
 
 
 class WORegisterClient:

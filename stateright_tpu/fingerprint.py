@@ -46,6 +46,12 @@ _T_ENUM = 0x0C
 _T_CUSTOM = 0x0D
 
 
+def _type_tag(t: type) -> str:
+    """Module-qualified class tag: two same-named classes in different
+    modules (e.g. the two adapter ``ClientState``s) must not collide."""
+    return f"{t.__module__}.{t.__qualname__}"
+
+
 def _mix(h: int) -> int:
     """splitmix64 finalizer: bijective 64-bit mixer."""
     h &= MASK64
@@ -97,7 +103,7 @@ def _digest(value: Any, acc: int) -> int:
         # takes precedence (handled below).
         acc = _fold(acc, _T_TUPLE)
         if t is not tuple:
-            acc = _hash_bytes(acc, t.__qualname__.encode("utf-8"))
+            acc = _hash_bytes(acc, _type_tag(t).encode("utf-8"))
         for item in value:
             acc = _digest(item, acc)
         return _fold(acc, len(value))
@@ -120,16 +126,16 @@ def _digest(value: Any, acc: int) -> int:
         return _fold(acc, len(value))
     if isinstance(value, Enum):
         acc = _fold(acc, _T_ENUM)
-        acc = _hash_bytes(acc, type(value).__qualname__.encode("utf-8"))
+        acc = _hash_bytes(acc, _type_tag(t).encode("utf-8"))
         return _digest(value.value, acc)
     custom = getattr(value, "__fingerprint_key__", None)
     if custom is not None:
         acc = _fold(acc, _T_CUSTOM)
-        acc = _hash_bytes(acc, type(value).__qualname__.encode("utf-8"))
+        acc = _hash_bytes(acc, _type_tag(type(value)).encode("utf-8"))
         return _digest(custom(), acc)
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
         acc = _fold(acc, _T_DATACLASS)
-        acc = _hash_bytes(acc, type(value).__qualname__.encode("utf-8"))
+        acc = _hash_bytes(acc, _type_tag(type(value)).encode("utf-8"))
         for f in dataclasses.fields(value):
             acc = _digest(getattr(value, f.name), acc)
         return acc

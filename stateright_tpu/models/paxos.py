@@ -1,0 +1,218 @@
+"""Single Decree Paxos, model-checked for linearizability.
+
+Mirrors ``/root/reference/examples/paxos.rs``: a cluster of Paxos servers
+(two-phase consensus: Prepare/Prepared leadership handoff, Accept/Accepted
+quorum decision, Decided dissemination — paxos.rs:66-248) fronted by the
+register protocol (Put/Get), with scripted register clients and a
+``LinearizabilityTester`` riding in the model history.
+
+The exact-count oracle is the reference's own test: 16,668 unique states at
+2 clients / 3 servers on an unordered non-duplicating network
+(paxos.rs:321,345).
+
+A term is coupled to the life of a client request — each Put starts a new
+ballot — matching the classic single-decree presentation (paxos.rs:44-47).
+"""
+
+from __future__ import annotations
+
+from typing import Any, FrozenSet, NamedTuple, Optional, Tuple
+
+from ..actor import Actor, ActorModel, Id, Network, majority, model_peers
+from ..actor import register as reg
+from ..core import Expectation
+from ..semantics import LinearizabilityTester
+from ..semantics.register import Register
+from ..utils.variant import variant
+
+Ballot = Tuple[int, Id]  # (round, leader id), lexicographic order
+Proposal = Tuple[int, Id, Any]  # (request_id, requester, value)
+
+# variants, not NamedTuples: Accept(b, p) must not equal Decided(b, p) in
+# the modeled network (Rust enum variants never compare equal, paxos.rs:65).
+Prepare = variant("Prepare", ["ballot"])
+Prepared = variant("Prepared", ["ballot", "last_accepted"])
+Accept = variant("Accept", ["ballot", "proposal"])
+Accepted = variant("Accepted", ["ballot"])
+Decided = variant("Decided", ["ballot", "proposal"])
+
+
+class PaxosState(NamedTuple):
+    """Combined leader/acceptor state (paxos.rs:90-103).
+
+    ``prepares`` is a map ``Id -> Option<(Ballot, Proposal)>`` stored as a
+    frozenset of pairs (the Python rendering of ``HashableHashMap``);
+    ``accepts`` is a frozenset of acceptor ids."""
+
+    ballot: Ballot
+    proposal: Optional[Proposal]
+    prepares: FrozenSet[Tuple[Id, Optional[Tuple[Ballot, Proposal]]]]
+    accepts: FrozenSet[Id]
+    accepted: Optional[Tuple[Ballot, Proposal]]
+    is_decided: bool
+
+
+def _map_insert(m: FrozenSet, k: Any, v: Any) -> FrozenSet:
+    d = dict(m)
+    d[k] = v
+    return frozenset(d.items())
+
+
+def _accepted_order(v: Optional[Tuple[Ballot, Proposal]]):
+    # Option ordering: None < Some, Some compared lexicographically.
+    return (0,) if v is None else (1, v)
+
+
+class PaxosActor(Actor):
+    """One Paxos server; plays both leader and acceptor (paxos.rs:110-248)."""
+
+    def __init__(self, peer_ids):
+        self.peer_ids = list(peer_ids)
+
+    def on_start(self, id: Id, out) -> PaxosState:
+        return PaxosState(
+            ballot=(0, Id(0)),
+            proposal=None,
+            prepares=frozenset(),
+            accepts=frozenset(),
+            accepted=None,
+            is_decided=False,
+        )
+
+    def on_msg(self, id: Id, state, src: Id, msg: Any, out) -> None:
+        s: PaxosState = state.get()
+        if s.is_decided:
+            # Once decided, only Gets are serviced; an undecided server does
+            # not reply to Get at all, since a decision may exist elsewhere
+            # (paxos.rs:139-151).
+            if isinstance(msg, reg.Get):
+                _ballot, (_req_id, _src, value) = s.accepted
+                out.send(src, reg.GetOk(msg.request_id, value))
+            return
+
+        if isinstance(msg, reg.Put):
+            if s.proposal is not None:
+                return  # ignored: a proposal is already in flight
+            # Start a new term; simulate Prepare/Prepared self-sends
+            # (paxos.rs:154-171).
+            ballot = (s.ballot[0] + 1, id)
+            state.set(
+                s._replace(
+                    proposal=(msg.request_id, src, msg.value),
+                    prepares=_map_insert(frozenset(), id, s.accepted),
+                    accepts=frozenset(),
+                    ballot=ballot,
+                )
+            )
+            out.broadcast(self.peer_ids, reg.Internal(Prepare(ballot)))
+            return
+
+        if not isinstance(msg, reg.Internal):
+            return
+        m = msg.msg
+
+        if isinstance(m, Prepare) and s.ballot < m.ballot:
+            # Close earlier terms; report previously accepted proposal
+            # (paxos.rs:172-181).
+            state.set(s._replace(ballot=m.ballot))
+            out.send(src, reg.Internal(Prepared(m.ballot, s.accepted)))
+
+        elif isinstance(m, Prepared) and m.ballot == s.ballot:
+            # Leadership handoff: once a quorum has closed earlier terms,
+            # drive the most recently accepted proposal if any, else the
+            # client's (paxos.rs:182-221).
+            prepares = _map_insert(s.prepares, src, m.last_accepted)
+            s2 = s._replace(prepares=prepares)
+            if len(prepares) == majority(len(self.peer_ids) + 1):
+                best = max((v for _k, v in prepares), key=_accepted_order)
+                if best is not None:
+                    proposal = best[1]
+                else:
+                    assert s2.proposal is not None, "proposal expected"
+                    proposal = s2.proposal
+                # Simulate Accept/Accepted self-sends.
+                s2 = s2._replace(
+                    proposal=proposal,
+                    accepted=(m.ballot, proposal),
+                    accepts=frozenset((id,)),
+                )
+                out.broadcast(
+                    self.peer_ids, reg.Internal(Accept(m.ballot, proposal))
+                )
+            state.set(s2)
+
+        elif isinstance(m, Accept) and s.ballot <= m.ballot:
+            # Acceptor accepts the proposal of the current-or-newer term
+            # (paxos.rs:222-227).
+            state.set(s._replace(ballot=m.ballot, accepted=(m.ballot, m.proposal)))
+            out.send(src, reg.Internal(Accepted(m.ballot)))
+
+        elif isinstance(m, Accepted) and m.ballot == s.ballot:
+            # Quorum of accepts = decision (paxos.rs:228-238).
+            accepts = s.accepts | {src}
+            s2 = s._replace(accepts=accepts)
+            if len(accepts) == majority(len(self.peer_ids) + 1):
+                s2 = s2._replace(is_decided=True)
+                assert s2.proposal is not None, "proposal expected"
+                request_id, requester_id, _value = s2.proposal
+                out.broadcast(
+                    self.peer_ids, reg.Internal(Decided(s.ballot, s2.proposal))
+                )
+                out.send(requester_id, reg.PutOk(request_id))
+            state.set(s2)
+
+        elif isinstance(m, Decided):
+            # Learn the decision (paxos.rs:239-244).
+            state.set(
+                s._replace(
+                    ballot=m.ballot,
+                    accepted=(m.ballot, m.proposal),
+                    is_decided=True,
+                )
+            )
+
+
+def paxos_model(
+    client_count: int = 2,
+    server_count: int = 3,
+    network: Optional[Network] = None,
+) -> ActorModel:
+    """Build the checkable model (paxos.rs:250-292): ``server_count`` Paxos
+    servers + ``client_count`` register clients, with an ``always
+    linearizable`` property over the history tester and a ``sometimes value
+    chosen`` reachability property."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    # serialized_history() is a backtracking search; histories recur across
+    # many states, so memoize consistency per distinct history value.
+    lin_cache: dict = {}
+
+    def linearizable(model, state) -> bool:
+        h = state.history
+        hit = lin_cache.get(h)
+        if hit is None:
+            hit = h.serialized_history() is not None
+            lin_cache[h] = hit
+        return hit
+
+    def value_chosen(model, state) -> bool:
+        for env in state.network.iter_deliverable():
+            if isinstance(env.msg, reg.GetOk) and env.msg.value is not None:
+                return True
+        return False
+
+    model = ActorModel(
+        cfg=None, init_history=LinearizabilityTester(Register(None))
+    )
+    for i in range(server_count):
+        model.actor(PaxosActor(model_peers(i, server_count)))
+    for _ in range(client_count):
+        model.actor(reg.RegisterClient(put_count=1, server_count=server_count))
+    return (
+        model.init_network(network)
+        .property(Expectation.ALWAYS, "linearizable", linearizable)
+        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        .record_msg_in(reg.record_returns)
+        .record_msg_out(reg.record_invocations)
+    )

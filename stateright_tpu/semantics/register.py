@@ -2,25 +2,15 @@
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any
 
+from ..utils.variant import variant
 from . import SequentialSpec
 
-
-class Write(NamedTuple):
-    value: Any
-
-
-class Read(NamedTuple):
-    pass
-
-
-class WriteOk(NamedTuple):
-    pass
-
-
-class ReadOk(NamedTuple):
-    value: Any
+Write = variant("Write", ["value"])
+Read = variant("Read", [])
+WriteOk = variant("WriteOk", [])
+ReadOk = variant("ReadOk", ["value"])
 
 
 class Register(SequentialSpec):

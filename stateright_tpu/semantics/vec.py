@@ -2,33 +2,17 @@
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, Tuple
 
+from ..utils.variant import variant
 from . import SequentialSpec
 
-
-class Push(NamedTuple):
-    value: Any
-
-
-class Pop(NamedTuple):
-    pass
-
-
-class Len(NamedTuple):
-    pass
-
-
-class PushOk(NamedTuple):
-    pass
-
-
-class PopOk(NamedTuple):
-    value: Optional[Any]  # None when empty
-
-
-class LenOk(NamedTuple):
-    length: int
+Push = variant("Push", ["value"])
+Pop = variant("Pop", [])
+Len = variant("Len", [])
+PushOk = variant("PushOk", [])
+PopOk = variant("PopOk", ["value"])  # value None when empty
+LenOk = variant("LenOk", ["length"])
 
 
 class VecSpec(SequentialSpec):

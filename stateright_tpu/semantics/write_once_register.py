@@ -4,29 +4,16 @@ of a *different* value fail, rewrites of the same value succeed."""
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, Optional
 
+from ..utils.variant import variant
 from . import SequentialSpec
 
-
-class Write(NamedTuple):
-    value: Any
-
-
-class Read(NamedTuple):
-    pass
-
-
-class WriteOk(NamedTuple):
-    pass
-
-
-class WriteFail(NamedTuple):
-    pass
-
-
-class ReadOk(NamedTuple):
-    value: Optional[Any]  # None while unwritten
+Write = variant("Write", ["value"])
+Read = variant("Read", [])
+WriteOk = variant("WriteOk", [])
+WriteFail = variant("WriteFail", [])
+ReadOk = variant("ReadOk", ["value"])  # value None while unwritten
 
 
 class WORegister(SequentialSpec):

@@ -9,6 +9,7 @@ stable hashing, util.rs:73-366) have no separate classes here: plain
 
 from .densenatmap import DenseNatMap
 from .rewrite_plan import RewritePlan, rewrite
+from .variant import variant
 from .vector_clock import VectorClock
 
-__all__ = ["DenseNatMap", "RewritePlan", "VectorClock", "rewrite"]
+__all__ = ["DenseNatMap", "RewritePlan", "VectorClock", "rewrite", "variant"]

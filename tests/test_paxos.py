@@ -1,0 +1,44 @@
+"""Paxos example parity tests.
+
+Oracle: the reference's own test ``can_model_paxos`` asserts 16,668 unique
+states at 2 clients / 3 servers on an unordered non-duplicating network and
+an 8-action witness for "value chosen" (examples/paxos.rs:294-346), for both
+BFS and DFS.
+"""
+
+import pytest
+
+from stateright_tpu.actor import register as reg
+from stateright_tpu.actor.model import DeliverAction
+from stateright_tpu.models.paxos import paxos_model
+
+
+def _check(spawn, shortest_witness: bool):
+    model = paxos_model(client_count=2, server_count=3)
+    checker = spawn(model.checker()).join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 16_668
+    witness = checker.discoveries()["value chosen"]
+    pairs = witness.into_vec()
+    actions = [a for _s, a in pairs if a is not None]
+    if shortest_witness:
+        # BFS finds the 8-action shortest witness (examples/paxos.rs:311-320).
+        assert len(actions) == 8
+        assert isinstance(actions[0].msg, reg.Put)
+        assert isinstance(actions[-1].msg, reg.Get)
+    assert all(isinstance(a, DeliverAction) for a in actions)
+    final = pairs[-1][0]
+    assert any(
+        isinstance(env.msg, reg.GetOk) and env.msg.value is not None
+        for env in final.network.iter_deliverable()
+    )
+
+
+@pytest.mark.slow
+def test_can_model_paxos_bfs():
+    _check(lambda b: b.spawn_bfs(), shortest_witness=True)
+
+
+@pytest.mark.slow
+def test_can_model_paxos_dfs():
+    _check(lambda b: b.spawn_dfs(), shortest_witness=False)

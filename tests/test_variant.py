@@ -1,0 +1,50 @@
+"""Enum-variant tuple semantics: the regression guard for the modeled
+network's message identity.
+
+Rust enum variants with identical payloads are never equal (derived
+PartialEq/Hash include the discriminant); bare Python NamedTuples ARE equal
+(`Accept(b,p) == Decided(b,p)`), which silently merged distinct messages in
+the network multiset and corrupted state-space counts (caught by Paxos
+parity: 19,816 states instead of the reference's 16,668).
+"""
+
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.utils.variant import variant
+
+A = variant("A", ["x", "y"])
+B = variant("B", ["x", "y"])
+
+
+def test_cross_class_inequality():
+    assert A(1, 2) != B(1, 2)
+    assert A(1, 2) != (1, 2)
+    assert A(1, 2) == A(1, 2)
+    assert A(1, 2) != A(1, 3)
+
+
+def test_hash_and_fingerprint_distinguish_classes():
+    assert hash(A(1, 2)) != hash(B(1, 2))
+    assert fingerprint(A(1, 2)) != fingerprint(B(1, 2))
+    assert fingerprint(A(1, 2)) == fingerprint(A(1, 2))
+    # Sets/dicts keyed by messages keep variants separate.
+    assert len({A(1, 2), B(1, 2)}) == 2
+    assert len({A(1, 2): 1, B(1, 2): 1}) == 2
+
+
+def test_namedtuple_conveniences_preserved():
+    a = A(1, 2)
+    assert a.x == 1 and a.y == 2
+    assert a._replace(y=3) == A(1, 3)
+    x, y = a
+    assert (x, y) == (1, 2)
+    assert repr(a) == "A(x=1, y=2)"
+
+
+def test_same_name_different_module_fingerprints_differ():
+    from stateright_tpu.actor.register import ClientState as RegClientState
+    from stateright_tpu.actor.write_once_register import (
+        ClientState as WOClientState,
+    )
+
+    assert RegClientState(None, 1) != WOClientState(None, 1)
+    assert fingerprint(RegClientState(None, 1)) != fingerprint(WOClientState(None, 1))
