@@ -70,6 +70,35 @@ def record_returns(cfg, history, env):
     return None
 
 
+def linearizable_condition():
+    """An ``always`` property condition: the history (a
+    ``LinearizabilityTester`` riding in the model state) admits a legal
+    serialization. ``serialized_history()`` is a backtracking search and
+    histories recur across many states, so consistency is memoized per
+    distinct history value (one cache per built model)."""
+    cache: dict = {}
+
+    def linearizable(_model, state) -> bool:
+        h = state.history
+        hit = cache.get(h)
+        if hit is None:
+            hit = h.serialized_history() is not None
+            cache[h] = hit
+        return hit
+
+    return linearizable
+
+
+def value_chosen_condition(_model=None, state=None) -> bool:
+    """A ``sometimes`` property condition: some deliverable ``GetOk``
+    carries a real (written) value — the register protocols' reachability
+    check (e.g. single-copy-register.rs:73-82)."""
+    for env in state.network.iter_deliverable():
+        if isinstance(env.msg, GetOk) and env.msg.value is not None:
+            return True
+    return False
+
+
 ClientState = variant("ClientState", ["awaiting", "op_count"])
 
 

@@ -1,0 +1,113 @@
+"""Racy shared counter: the canonical symmetry-reduction demo.
+
+Mirrors ``/root/reference/examples/increment.rs``: N threads each execute
+``1: t = SHARED; 2: SHARED = t + 1; 3:`` with the two instructions atomic but
+interleavable, so the final counter can undercount. The ``fin`` invariant
+("SHARED equals the number of finished threads") is intentionally violated.
+
+The reference's doc comment enumerates the state space for 2 threads: 13
+unique states without symmetry reduction, 8 with it (increment.rs:31-105) —
+those are the exact-count oracles for the tests here.
+
+States are plain nested tuples — hashable, orderable, and trivially
+canonicalizable by sorting the per-thread slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Tuple
+
+from ..core import Model, Property
+from ..utils.variant import variant
+
+Proc = Tuple[int, int]  # (thread-local value t, program counter pc)
+
+Read = variant("Read", ["thread"])
+Write = variant("Write", ["thread"])
+
+
+class IncrementState(NamedTuple):
+    """(shared counter, per-thread (t, pc) slices) — increment.rs:117-131."""
+
+    i: int
+    s: Tuple[Proc, ...]
+
+    def representative(self) -> "IncrementState":
+        """Threads are interchangeable: the canonical class member sorts the
+        thread slice (increment.rs:142-151)."""
+        return IncrementState(self.i, tuple(sorted(self.s)))
+
+
+class Increment(Model):
+    """The model (increment.rs:153-197): the initial state doubles as the
+    model value, as in the reference."""
+
+    def __init__(self, thread_count: int = 3):
+        self.thread_count = thread_count
+
+    def init_states(self) -> List[IncrementState]:
+        return [IncrementState(0, tuple((0, 1) for _ in range(self.thread_count)))]
+
+    def actions(self, state: IncrementState, actions: List[Any]) -> None:
+        for thread_id, (_t, pc) in enumerate(state.s):
+            if pc == 1:
+                actions.append(Read(thread_id))
+            elif pc == 2:
+                actions.append(Write(thread_id))
+
+    def next_state(self, last_state: IncrementState, action: Any):
+        s = list(last_state.s)
+        if isinstance(action, Read):
+            s[action.thread] = (last_state.i, 2)
+            return IncrementState(last_state.i, tuple(s))
+        t, _pc = s[action.thread]
+        s[action.thread] = (t, 3)
+        return IncrementState(t + 1, tuple(s))
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.always(
+                "fin",
+                lambda _m, state: sum(1 for _t, pc in state.s if pc == 3) == state.i,
+            )
+        ]
+
+
+def main(argv=None) -> None:
+    """CLI mirroring increment.rs:199-254."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        thread_count = int(args.pop(0)) if args else 3
+        print(f"Model checking increment with {thread_count} threads.")
+        Increment(thread_count).checker().spawn_dfs().report(WriteReporter())
+    elif cmd == "check-sym":
+        thread_count = int(args.pop(0)) if args else 3
+        print(
+            f"Model checking increment with {thread_count} threads "
+            f"using symmetry reduction."
+        )
+        Increment(thread_count).checker().symmetry().spawn_dfs().report(
+            WriteReporter()
+        )
+    elif cmd == "explore":
+        thread_count = int(args.pop(0)) if args else 3
+        address = args.pop(0) if args else "localhost:3000"
+        print(
+            f"Exploring the state space of increment with {thread_count} "
+            f"threads on {address}."
+        )
+        Increment(thread_count).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  increment check [THREAD_COUNT]")
+        print("  increment check-sym [THREAD_COUNT]")
+        print("  increment explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

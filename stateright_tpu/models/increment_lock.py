@@ -1,0 +1,124 @@
+"""Shared counter guarded by a lock: the fixed version of ``increment``.
+
+Mirrors ``/root/reference/examples/increment_lock.rs``: each thread executes
+``0: lock; 1: t = SHARED; 2: SHARED = t + 1; 3: unlock; 4:``, so the ``fin``
+invariant ("SHARED equals the number of threads past their write") and the
+``mutex`` invariant ("at most one thread inside the critical section") both
+hold — the checker finds no counterexample.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Tuple
+
+from ..core import Model, Property
+from ..utils.variant import variant
+
+Proc = Tuple[int, int]  # (thread-local value t, program counter pc)
+
+Lock = variant("Lock", ["thread"])
+Read = variant("Read", ["thread"])
+Write = variant("Write", ["thread"])
+Release = variant("Release", ["thread"])
+
+
+class IncrementLockState(NamedTuple):
+    """(shared counter, lock bit, per-thread (t, pc)) — increment_lock.rs:19-33."""
+
+    i: int
+    lock: bool
+    s: Tuple[Proc, ...]
+
+    def representative(self) -> "IncrementLockState":
+        """Sort the interchangeable thread slice (increment_lock.rs:36-46)."""
+        return IncrementLockState(self.i, self.lock, tuple(sorted(self.s)))
+
+
+class IncrementLock(Model):
+    """The model (increment_lock.rs:48-107)."""
+
+    def __init__(self, thread_count: int = 3):
+        self.thread_count = thread_count
+
+    def init_states(self) -> List[IncrementLockState]:
+        return [
+            IncrementLockState(0, False, tuple((0, 0) for _ in range(self.thread_count)))
+        ]
+
+    def actions(self, state: IncrementLockState, actions: List[Any]) -> None:
+        for thread_id, (_t, pc) in enumerate(state.s):
+            if pc == 0 and not state.lock:
+                actions.append(Lock(thread_id))
+            elif pc == 1:
+                actions.append(Read(thread_id))
+            elif pc == 2:
+                actions.append(Write(thread_id))
+            elif pc == 3 and state.lock:
+                actions.append(Release(thread_id))
+
+    def next_state(self, last_state: IncrementLockState, action: Any):
+        s = list(last_state.s)
+        t, _pc = s[action.thread]
+        if isinstance(action, Lock):
+            s[action.thread] = (t, 1)
+            return last_state._replace(lock=True, s=tuple(s))
+        if isinstance(action, Read):
+            s[action.thread] = (last_state.i, 2)
+            return last_state._replace(s=tuple(s))
+        if isinstance(action, Write):
+            s[action.thread] = (t, 3)
+            return last_state._replace(i=t + 1, s=tuple(s))
+        s[action.thread] = (t, 4)
+        return last_state._replace(lock=False, s=tuple(s))
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.always(
+                "fin",
+                lambda _m, state: sum(1 for _t, pc in state.s if pc >= 3) == state.i,
+            ),
+            Property.always(
+                "mutex",
+                lambda _m, state: sum(1 for _t, pc in state.s if 1 <= pc < 4) <= 1,
+            ),
+        ]
+
+
+def main(argv=None) -> None:
+    """CLI mirroring increment_lock.rs:109-161."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        thread_count = int(args.pop(0)) if args else 3
+        print(f"Model checking increment_lock with {thread_count} threads.")
+        IncrementLock(thread_count).checker().spawn_dfs().report(WriteReporter())
+    elif cmd == "check-sym":
+        thread_count = int(args.pop(0)) if args else 3
+        print(
+            f"Model checking increment_lock with {thread_count} threads "
+            f"using symmetry reduction."
+        )
+        IncrementLock(thread_count).checker().symmetry().spawn_dfs().report(
+            WriteReporter()
+        )
+    elif cmd == "explore":
+        thread_count = int(args.pop(0)) if args else 3
+        address = args.pop(0) if args else "localhost:3000"
+        print(
+            f"Exploring the state space of increment_lock with {thread_count} "
+            f"threads on {address}."
+        )
+        IncrementLock(thread_count).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  increment_lock check [THREAD_COUNT]")
+        print("  increment_lock check-sym [THREAD_COUNT]")
+        print("  increment_lock explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""ABD linearizable register: quorum-replicated shared memory.
+
+Mirrors ``/root/reference/examples/linearizable-register.rs``: the Attiya,
+Bar-Noy, Dolev algorithm ("Sharing Memory Robustly in Message-Passing
+Systems", doi:10.1145/200836.200869). Every operation runs two phases:
+
+1. **Query**: poll a quorum for (logical-clock sequencer, value) pairs;
+2. **Record**: write back the maximal pair (for a write: the incremented
+   sequencer and the new value) and wait for a quorum of acks.
+
+Because both reads and writes perform the write-back phase, the register is
+linearizable with any majority quorum.
+
+Exact-count oracle from the reference's own test
+(linearizable-register.rs:289,316): 544 unique states at 2 clients /
+2 servers on an unordered non-duplicating network, both BFS and DFS.
+"""
+
+from __future__ import annotations
+
+from typing import Any, FrozenSet, NamedTuple, Optional, Tuple
+
+from ..actor import (
+    Actor,
+    ActorModel,
+    Id,
+    Network,
+    Out,
+    StateRef,
+    majority,
+    model_peers,
+)
+from ..actor import register as reg
+from ..core import Expectation
+from ..semantics import LinearizabilityTester
+from ..semantics.register import Register
+from ..utils.variant import variant
+
+Seq = Tuple[int, Id]  # (logical clock, writer id) — totally ordered
+
+# Internal ABD protocol messages (linearizable-register.rs:28-33).
+Query = variant("Query", ["request_id"])
+AckQuery = variant("AckQuery", ["request_id", "seq", "value"])
+Record = variant("Record", ["request_id", "seq", "value"])
+AckRecord = variant("AckRecord", ["request_id"])
+
+# The two client-request phases (linearizable-register.rs:44-57).
+# ``responses`` is a map Id -> (Seq, Value) stored as a frozenset of pairs;
+# ``acks`` is a frozenset of replica ids.  ``write`` (phase 1) and ``read``
+# (phase 2) are ``None`` for the other operation kind and a 1-tuple
+# ``(value,)`` otherwise — the tuple keeps a value of ``None`` (a read of
+# the unwritten default, or a Put of None) distinct from "not this kind of
+# operation" (Rust's Option<Value> makes the same distinction, rs:48,54).
+Phase1 = variant("Phase1", ["request_id", "requester_id", "write", "responses"])
+Phase2 = variant("Phase2", ["request_id", "requester_id", "read", "acks"])
+
+
+class AbdState(NamedTuple):
+    """Replica state (linearizable-register.rs:37-41)."""
+
+    seq: Seq
+    val: Any
+    phase: Optional[Any]
+
+
+def _map_insert(m: FrozenSet, k: Any, v: Any) -> FrozenSet:
+    d = dict(m)
+    d[k] = v
+    return frozenset(d.items())
+
+
+class AbdActor(Actor):
+    """One ABD replica; also coordinates client requests
+    (linearizable-register.rs:64-214)."""
+
+    def __init__(self, peers):
+        self.peers = list(peers)
+
+    def on_start(self, id: Id, out: Out) -> AbdState:
+        return AbdState(seq=(0, id), val=None, phase=None)
+
+    def on_msg(self, id: Id, state: StateRef, src: Id, msg: Any, out: Out) -> None:
+        s: AbdState = state.get()
+
+        if isinstance(msg, (reg.Put, reg.Get)) and s.phase is None:
+            # Begin phase 1: poll a quorum, seeding with our own pair
+            # (linearizable-register.rs:86-111). ``write`` is a 1-tuple so a
+            # Put of ``None`` stays distinct from a Get (same trick as
+            # ``read`` below).
+            write = (msg.value,) if isinstance(msg, reg.Put) else None
+            out.broadcast(self.peers, reg.Internal(Query(msg.request_id)))
+            state.set(
+                s._replace(
+                    phase=Phase1(
+                        request_id=msg.request_id,
+                        requester_id=src,
+                        write=write,
+                        responses=_map_insert(frozenset(), id, (s.seq, s.val)),
+                    )
+                )
+            )
+            return
+
+        if not isinstance(msg, reg.Internal):
+            return
+        m = msg.msg
+
+        if isinstance(m, Query):
+            out.send(src, reg.Internal(AckQuery(m.request_id, s.seq, s.val)))
+
+        elif (
+            isinstance(m, AckQuery)
+            and isinstance(s.phase, Phase1)
+            and s.phase.request_id == m.request_id
+        ):
+            # Collect quorum responses; on quorum, pick the maximal
+            # (seq, value), bump the clock for writes, and move to phase 2
+            # with Record/AckRecord self-sends applied inline
+            # (linearizable-register.rs:118-176).
+            p = s.phase
+            responses = _map_insert(p.responses, src, (m.seq, m.value))
+            if len(responses) < majority(len(self.peers) + 1):
+                state.set(s._replace(phase=p._replace(responses=responses)))
+                return
+            # Sequencers are distinct ((clock, id) pairs), so max is
+            # deterministic (comment at linearizable-register.rs:139-142).
+            seq, val = max((v for _k, v in responses), key=lambda sv: sv[0])
+            read = None
+            if p.write is not None:
+                seq = (seq[0] + 1, id)
+                val = p.write[0]
+            else:
+                read = (val,)
+            out.broadcast(self.peers, reg.Internal(Record(p.request_id, seq, val)))
+            s2 = s
+            if seq > s.seq:  # self-send Record
+                s2 = s2._replace(seq=seq, val=val)
+            state.set(
+                s2._replace(
+                    phase=Phase2(
+                        request_id=p.request_id,
+                        requester_id=p.requester_id,
+                        read=read,
+                        acks=frozenset((id,)),  # self-send AckRecord
+                    )
+                )
+            )
+
+        elif isinstance(m, Record):
+            # Adopt newer pairs; always ack (linearizable-register.rs:177-184).
+            out.send(src, reg.Internal(AckRecord(m.request_id)))
+            if m.seq > s.seq:
+                state.set(s._replace(seq=m.seq, val=m.value))
+
+        elif (
+            isinstance(m, AckRecord)
+            and isinstance(s.phase, Phase2)
+            and s.phase.request_id == m.request_id
+            and src not in s.phase.acks
+        ):
+            # On an ack quorum, answer the client and clear the phase
+            # (linearizable-register.rs:185-210).
+            p = s.phase
+            acks = p.acks | {src}
+            if len(acks) == majority(len(self.peers) + 1):
+                if p.read is not None:
+                    out.send(p.requester_id, reg.GetOk(p.request_id, p.read[0]))
+                else:
+                    out.send(p.requester_id, reg.PutOk(p.request_id))
+                state.set(s._replace(phase=None))
+            else:
+                state.set(s._replace(phase=p._replace(acks=acks)))
+
+
+def linearizable_register_model(
+    client_count: int = 2,
+    server_count: int = 2,
+    network: Optional[Network] = None,
+) -> ActorModel:
+    """Build the checkable model (linearizable-register.rs:223-257)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    model = ActorModel(cfg=None, init_history=LinearizabilityTester(Register(None)))
+    for i in range(server_count):
+        model.actor(AbdActor(model_peers(i, server_count)))
+    for _ in range(client_count):
+        model.actor(reg.RegisterClient(put_count=1, server_count=server_count))
+    return (
+        model.init_network(network)
+        .property(Expectation.ALWAYS, "linearizable", reg.linearizable_condition())
+        .property(Expectation.SOMETIMES, "value chosen", reg.value_chosen_condition)
+        .record_msg_in(reg.record_returns)
+        .record_msg_out(reg.record_invocations)
+    )
+
+
+def main(argv=None) -> None:
+    """CLI mirroring linearizable-register.rs:319-430."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        client_count = int(args.pop(0)) if args else 2
+        network = Network.from_name(args.pop(0)) if args else None
+        print(f"Model checking a linearizable register with {client_count} clients.")
+        (
+            linearizable_register_model(client_count, 3, network)
+            .checker()
+            .spawn_dfs()
+            .report(WriteReporter())
+        )
+    elif cmd == "explore":
+        client_count = int(args.pop(0)) if args else 2
+        address = args.pop(0) if args else "localhost:3000"
+        network = Network.from_name(args.pop(0)) if args else None
+        print(
+            f"Exploring state space for linearizable register with "
+            f"{client_count} clients on {address}."
+        )
+        linearizable_register_model(client_count, 3, network).checker().serve(address)
+    elif cmd == "spawn":
+        from ..actor.spawn import json_codec, spawn
+
+        port = 3000
+        ids = [Id.from_addr("127.0.0.1", port + i) for i in range(3)]
+        serialize, deserialize = json_codec(
+            reg.Put, reg.Get, reg.PutOk, reg.GetOk, reg.Internal,
+            Query, AckQuery, Record, AckRecord,
+        )
+        print("  Three servers that implement a linearizable register.")
+        print("  You can interact using netcat:")
+        print(f"$ nc -u localhost {port}")
+        print(serialize(reg.Put(1, "X")).decode())
+        print(serialize(reg.Get(2)).decode())
+        spawn(
+            serialize,
+            deserialize,
+            [
+                (ids[i], AbdActor([x for x in ids if x != ids[i]]))
+                for i in range(3)
+            ],
+        )
+    else:
+        print("USAGE:")
+        print("  linearizable-register check [CLIENT_COUNT] [NETWORK]")
+        print("  linearizable-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
+        print("  linearizable-register spawn")
+        print(f"NETWORK: {' | '.join(Network.names())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -184,24 +184,6 @@ def paxos_model(
     if network is None:
         network = Network.new_unordered_nonduplicating()
 
-    # serialized_history() is a backtracking search; histories recur across
-    # many states, so memoize consistency per distinct history value.
-    lin_cache: dict = {}
-
-    def linearizable(model, state) -> bool:
-        h = state.history
-        hit = lin_cache.get(h)
-        if hit is None:
-            hit = h.serialized_history() is not None
-            lin_cache[h] = hit
-        return hit
-
-    def value_chosen(model, state) -> bool:
-        for env in state.network.iter_deliverable():
-            if isinstance(env.msg, reg.GetOk) and env.msg.value is not None:
-                return True
-        return False
-
     model = ActorModel(
         cfg=None, init_history=LinearizabilityTester(Register(None))
     )
@@ -211,8 +193,8 @@ def paxos_model(
         model.actor(reg.RegisterClient(put_count=1, server_count=server_count))
     return (
         model.init_network(network)
-        .property(Expectation.ALWAYS, "linearizable", linearizable)
-        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        .property(Expectation.ALWAYS, "linearizable", reg.linearizable_condition())
+        .property(Expectation.SOMETIMES, "value chosen", reg.value_chosen_condition)
         .record_msg_in(reg.record_returns)
         .record_msg_out(reg.record_invocations)
     )

@@ -1,0 +1,122 @@
+"""Single-copy register: each server exposes a rewritable register with no
+consensus between servers.
+
+Mirrors ``/root/reference/examples/single-copy-register.rs``: the system is
+linearizable iff there is exactly one server (one copy); with two or more
+servers clients can observe stale values and the ``linearizable`` property
+yields a counterexample.
+
+Exact-count oracles from the reference's own test
+(single-copy-register.rs:110,136): 93 unique states at 2 clients / 1 server
+(full coverage), 20 unique states at 2 clients / 2 servers (BFS stops at the
+linearizability counterexample).
+
+The reference's ``Value::default()`` (``'\\u{0}'``) is rendered as ``None``:
+the "unwritten" register value, consistent with the ``Register(None)`` spec
+initial state used throughout this package.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..actor import Actor, ActorModel, Id, Network, Out, StateRef
+from ..actor import register as reg
+from ..core import Expectation
+from ..semantics import LinearizabilityTester
+from ..semantics.register import Register
+
+
+class SingleCopyActor(Actor):
+    """A server holding one unreplicated register value
+    (single-copy-register.rs:18-46). The actor state *is* the value."""
+
+    def on_start(self, id: Id, out: Out):
+        return None  # the unwritten value (Value::default())
+
+    def on_msg(self, id: Id, state: StateRef, src: Id, msg, out: Out) -> None:
+        if isinstance(msg, reg.Put):
+            state.set(msg.value)
+            out.send(src, reg.PutOk(msg.request_id))
+        elif isinstance(msg, reg.Get):
+            out.send(src, reg.GetOk(msg.request_id, state.get()))
+        # Internal messages don't exist for this protocol; anything else is
+        # ignored (a no-op action, suppressed by the model).
+
+
+def single_copy_register_model(
+    client_count: int = 2,
+    server_count: int = 1,
+    network: Optional[Network] = None,
+) -> ActorModel:
+    """Build the checkable model (single-copy-register.rs:55-86)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    model = ActorModel(cfg=None, init_history=LinearizabilityTester(Register(None)))
+    for _ in range(server_count):
+        model.actor(SingleCopyActor())
+    for _ in range(client_count):
+        model.actor(reg.RegisterClient(put_count=1, server_count=server_count))
+    return (
+        model.init_network(network)
+        .property(Expectation.ALWAYS, "linearizable", reg.linearizable_condition())
+        .property(Expectation.SOMETIMES, "value chosen", reg.value_chosen_condition)
+        .record_msg_in(reg.record_returns)
+        .record_msg_out(reg.record_invocations)
+    )
+
+
+def main(argv=None) -> None:
+    """CLI mirroring single-copy-register.rs:139-233:
+    ``check``/``explore``/``spawn`` subcommands."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        client_count = int(args.pop(0)) if args else 2
+        network = Network.from_name(args.pop(0)) if args else None
+        print(f"Model checking a single-copy register with {client_count} clients.")
+        (
+            single_copy_register_model(client_count, 1, network)
+            .checker()
+            .spawn_dfs()
+            .report(WriteReporter())
+        )
+    elif cmd == "explore":
+        client_count = int(args.pop(0)) if args else 2
+        address = args.pop(0) if args else "localhost:3000"
+        network = Network.from_name(args.pop(0)) if args else None
+        print(
+            f"Exploring state space for single-copy register with "
+            f"{client_count} clients on {address}."
+        )
+        single_copy_register_model(client_count, 1, network).checker().serve(address)
+    elif cmd == "spawn":
+        from ..actor.spawn import json_codec, spawn
+
+        port = 3000
+        serialize, deserialize = json_codec(reg.Put, reg.Get, reg.PutOk, reg.GetOk)
+        print("  A server that implements a single-copy register.")
+        print("  You can interact using netcat:")
+        print(f"$ nc -u localhost {port}")
+        print(serialize(reg.Put(1, "X")).decode())
+        print(serialize(reg.Get(2)).decode())
+        spawn(
+            serialize,
+            deserialize,
+            [(Id.from_addr("127.0.0.1", port), SingleCopyActor())],
+        )
+    else:
+        print("USAGE:")
+        print("  single-copy-register check [CLIENT_COUNT] [NETWORK]")
+        print("  single-copy-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
+        print("  single-copy-register spawn")
+        print(f"NETWORK: {' | '.join(Network.names())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Timer-semantics demo: pingers driven entirely by model timers.
+
+Mirrors ``/root/reference/examples/timers.rs``: each actor sets three timers
+on start (``Even``, ``Odd``, ``NoOp``). In the model a timeout is a
+nondeterministic action (the duration range is irrelevant,
+actor/model.rs:59-64); firing ``Even``/``Odd`` re-arms the timer and pings
+the even/odd peers, while ``NoOp`` only re-arms itself — which the no-op
+detection (``is_no_op_with_timer``, actor.rs:254-264) suppresses, so ``NoOp``
+timeouts never generate states.
+
+The state space is unbounded (counters grow), so ``check`` bounds the run
+with ``target_state_count`` — use the Explorer to poke at it interactively.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional
+
+from ..actor import (
+    Actor,
+    ActorModel,
+    Id,
+    Network,
+    Out,
+    StateRef,
+    model_peers,
+    model_timeout,
+)
+from ..core import Expectation
+from ..utils.variant import variant
+
+Ping = variant("Ping", [])
+Pong = variant("Pong", [])
+
+Even = variant("Even", [])
+Odd = variant("Odd", [])
+NoOp = variant("NoOp", [])
+
+
+class PingerState(NamedTuple):
+    sent: int
+    received: int
+
+
+class PingerActor(Actor):
+    """timers.rs:32-96."""
+
+    def __init__(self, peer_ids):
+        self.peer_ids = list(peer_ids)
+
+    def on_start(self, id: Id, out: Out) -> PingerState:
+        out.set_timer(Even(), model_timeout())
+        out.set_timer(Odd(), model_timeout())
+        out.set_timer(NoOp(), model_timeout())
+        return PingerState(sent=0, received=0)
+
+    def on_msg(self, id: Id, state: StateRef, src: Id, msg: Any, out: Out) -> None:
+        if isinstance(msg, Ping):
+            out.send(src, Pong())
+        elif isinstance(msg, Pong):
+            s = state.get()
+            state.set(s._replace(received=s.received + 1))
+
+    def on_timeout(self, id: Id, state: StateRef, timer: Any, out: Out) -> None:
+        if isinstance(timer, NoOp):
+            out.set_timer(NoOp(), model_timeout())  # pure re-arm: a no-op
+            return
+        parity = 0 if isinstance(timer, Even) else 1
+        out.set_timer(timer, model_timeout())
+        for dst in self.peer_ids:
+            if int(dst) % 2 == parity:
+                s = state.get()
+                state.set(s._replace(sent=s.sent + 1))
+                out.send(dst, Ping())
+
+
+def timers_model(
+    server_count: int = 3, network: Optional[Network] = None
+) -> ActorModel:
+    """Build the checkable model (timers.rs:104-113)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+    model = ActorModel(cfg=None)
+    for i in range(server_count):
+        model.actor(PingerActor(model_peers(i, server_count)))
+    return model.init_network(network).property(
+        Expectation.ALWAYS, "true", lambda _m, _s: True
+    )
+
+
+def main(argv=None) -> None:
+    """CLI mirroring timers.rs:115-164 (``check`` bounded, see module doc)."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        network = Network.from_name(args.pop(0)) if args else None
+        print("Model checking Pingers (bounded to 100k states).")
+        (
+            timers_model(3, network)
+            .checker()
+            .target_state_count(100_000)
+            .spawn_dfs()
+            .report(WriteReporter())
+        )
+    elif cmd == "explore":
+        address = args.pop(0) if args else "localhost:3000"
+        network = Network.from_name(args.pop(0)) if args else None
+        print(f"Exploring state space for Pingers on {address}.")
+        timers_model(3, network).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  timers check [NETWORK]")
+        print("  timers explore [ADDRESS] [NETWORK]")
+        print(f"NETWORK: {' | '.join(Network.names())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Parity tests for the register example models.
+
+Oracles are the reference's own tests:
+
+- single-copy register: linearizable iff one server; 93 unique states at
+  2 clients / 1 server (DFS, full coverage) and 20 at 2 clients / 2 servers
+  (BFS, stops at the linearizability counterexample)
+  (examples/single-copy-register.rs:88-137).
+- ABD linearizable register: always linearizable; 544 unique states at
+  2 clients / 2 servers, both BFS and DFS
+  (examples/linearizable-register.rs:259-317).
+"""
+
+from stateright_tpu.actor import register as reg
+from stateright_tpu.actor.model import DeliverAction
+from stateright_tpu.models.linearizable_register import linearizable_register_model
+from stateright_tpu.models.single_copy_register import single_copy_register_model
+
+
+def test_single_copy_one_server_is_linearizable():
+    checker = (
+        single_copy_register_model(client_count=2, server_count=1)
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 93
+    witness = checker.discoveries()["value chosen"]
+    actions = [a for _s, a in witness.into_vec() if a is not None]
+    assert all(isinstance(a, DeliverAction) for a in actions)
+
+
+def test_single_copy_two_servers_not_linearizable():
+    checker = (
+        single_copy_register_model(client_count=2, server_count=2)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 20
+    cex = checker.discoveries()["linearizable"]
+    actions = [a for _s, a in cex.into_vec() if a is not None]
+    # The shortest counterexample: Put to one server acked, then a Get served
+    # stale by the other server (single-copy-register.rs:123-128).
+    assert len(actions) == 4
+    assert isinstance(actions[0].msg, reg.Put)
+    assert isinstance(actions[-1].msg, reg.GetOk)
+    assert actions[-1].msg.value is None
+    assert "value chosen" in checker.discoveries()
+
+
+def _check_abd(spawn, shortest_witness):
+    checker = (
+        spawn(linearizable_register_model(client_count=2, server_count=2).checker())
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 544
+    witness = checker.discoveries()["value chosen"]
+    actions = [a for _s, a in witness.into_vec() if a is not None]
+    if shortest_witness:
+        # Put (2 phases against a quorum) then Get reaching its quorum
+        # (linearizable-register.rs:276-288): 11 deliveries.
+        assert len(actions) == 11
+        assert isinstance(actions[0].msg, reg.Put)
+    assert all(isinstance(a, DeliverAction) for a in actions)
+
+
+def test_can_model_linearizable_register_bfs():
+    _check_abd(lambda b: b.spawn_bfs(), shortest_witness=True)
+
+
+def test_can_model_linearizable_register_dfs():
+    _check_abd(lambda b: b.spawn_dfs(), shortest_witness=False)
