@@ -1,0 +1,341 @@
+"""Explorer: an interactive web service for walking the state space.
+
+Mirrors ``/root/reference/src/checker/explorer.rs``: ``serve()`` wraps a
+demand-driven checker (``spawn_on_demand``) with a small HTTP API —
+
+- ``GET /.status`` → :class:`StatusView` JSON (done, model name, counts,
+  properties with encoded discovery paths, recent path snapshot)
+  (explorer.rs:156-176);
+- ``GET /.states/{fp}/{fp}/…`` → a list of ``StateView`` JSON objects: one
+  per action available in the state reached by replaying the fingerprint
+  path, including "ignored" actions (``next_state`` → None), and asks the
+  checker to expand each child on demand (explorer.rs:209-312);
+- ``POST /.runtocompletion`` → unblocks the checker (explorer.rs:178-187) —
+
+plus the single-page UI in ``stateright_tpu/ui/`` (an original
+implementation; the reference vendors a Knockout.js app with the same HTTP
+contract). UI files are read from ``./ui/`` if present (dev mode, like
+explorer.rs:118-131) else from the installed package.
+
+The app logic lives in :class:`ExplorerApp`, framework-free and directly
+callable — tests drive it without a live server, as the reference's tests
+call actix handlers directly (explorer.rs:314-588). The HTTP layer is a
+thin stdlib ``ThreadingHTTPServer`` handler; all checker access is
+serialized by a lock since the demand-driven engine is single-threaded.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path as FsPath
+from typing import Any, List, Optional, Tuple
+
+from ..core import Expectation
+from ..fingerprint import fingerprint
+from .path import Path
+
+_UI_DIR = FsPath(__file__).resolve().parent.parent / "ui"
+_UI_FILES = {
+    "/": ("index.htm", "text/html"),
+    "/app.css": ("app.css", "text/css"),
+    "/app.js": ("app.js", "text/javascript"),
+}
+
+#: serde renders Rust unit variants with their name (explorer.rs:13 via
+#: lib.rs:317), and the UI switches on these strings (ui/app.js:38-43).
+_EXPECTATION_NAMES = {
+    Expectation.ALWAYS: "Always",
+    Expectation.SOMETIMES: "Sometimes",
+    Expectation.EVENTUALLY: "Eventually",
+}
+
+
+class Snapshot:
+    """Most-recent-path visitor state, re-armed every 4 seconds
+    (explorer.rs:63-78, 90-96): between re-arms only the first visited path
+    is kept, so the "recent path" display is a cheap sample, not a log."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed = True
+        self.actions: Optional[List[Any]] = None
+
+    def visit(self, path: Path) -> None:
+        with self._lock:
+            if not self._armed:
+                return
+            self._armed = False
+            self.actions = path.into_actions()
+
+    def rearm(self) -> None:
+        with self._lock:
+            self._armed = True
+
+
+class ExplorerApp:
+    """The Explorer's request handlers, independent of any HTTP machinery."""
+
+    def __init__(self, checker, snapshot: Optional[Snapshot] = None):
+        self._checker = checker
+        self._snapshot = snapshot or Snapshot()
+        self._lock = threading.Lock()
+
+    # --- handlers ---------------------------------------------------------
+
+    def status(self) -> dict:
+        """``GET /.status`` (explorer.rs:156-176)."""
+        with self._lock:
+            checker = self._checker
+            recent = self._snapshot.actions
+            return {
+                "done": checker.is_done(),
+                "model": type(checker.model()).__name__,
+                "state_count": checker.state_count(),
+                "unique_state_count": checker.unique_state_count(),
+                "max_depth": checker.max_depth(),
+                "properties": self._properties(),
+                "recent_path": repr(recent) if recent is not None else None,
+            }
+
+    def run_to_completion(self) -> None:
+        """``POST /.runtocompletion`` (explorer.rs:178-187). Kicks the
+        engine forward so progress is visible from subsequent ``/.status``
+        polls even though this server has no background workers."""
+        with self._lock:
+            self._checker.run_to_completion()
+
+    def drive(self, max_count: int = 1500) -> None:
+        """Advance an unblocked checker by one block (the in-process
+        equivalent of the reference's background worker threads)."""
+        with self._lock:
+            if not self._checker.is_done():
+                self._checker._run_block(max_count)
+
+    def states(self, fingerprints_str: str) -> Tuple[int, Any]:
+        """``GET /.states{fingerprints}`` (explorer.rs:209-312). Returns
+        ``(http_status, body)``; 404 bodies are error strings."""
+        fingerprints_str = fingerprints_str.rstrip("/")
+        parts = fingerprints_str.split("/")
+        fingerprints: List[int] = []
+        for part in parts:
+            if not part:
+                continue
+            try:
+                fingerprints.append(int(part))
+            except ValueError:
+                return 404, f"Unable to parse fingerprints {fingerprints_str}"
+        # All but the leading empty segment must have parsed
+        # (explorer.rs:233-240).
+        if len(fingerprints) + 1 != len(parts):
+            return 404, f"Unable to parse fingerprints {fingerprints_str}"
+
+        with self._lock:
+            model = self._checker.model()
+            results = []
+            if not fingerprints:
+                for state in model.init_states():
+                    fp = fingerprint(state)
+                    self._checker.check_fingerprint(fp)
+                    results.append(
+                        self._state_view(model, None, None, state, [fp])
+                    )
+                return 200, results
+
+            last_state = Path.final_state(model, fingerprints)
+            if last_state is None:
+                return (
+                    404,
+                    f"Unable to find state following fingerprints {fingerprints_str}",
+                )
+            actions: List[Any] = []
+            model.actions(last_state, actions)
+            # check_fingerprint below can add discoveries, so evaluate the
+            # property triples once after all expansions, then share them
+            # across views (the reference rebuilds them per view,
+            # explorer.rs:256-301; once per request is observably the same).
+            views = []
+            for action in actions:
+                outcome = model.format_step(last_state, action)
+                state = model.next_state(last_state, action)
+                if state is not None:
+                    fp = fingerprint(state)
+                    self._checker.check_fingerprint(fp)
+                    views.append((action, outcome, state, fp))
+                else:
+                    # "Action ignored" is still returned — useful for
+                    # debugging (explorer.rs:292-300).
+                    views.append((action, None, None, None))
+            properties = self._properties()
+            for action, outcome, state, fp in views:
+                if state is not None:
+                    view = self._state_view(
+                        model,
+                        model.format_action(action),
+                        outcome,
+                        state,
+                        fingerprints + [fp],
+                        properties=properties,
+                    )
+                else:
+                    view = {
+                        "action": model.format_action(action),
+                        "properties": properties,
+                    }
+                results.append(view)
+            return 200, results
+
+    # --- helpers ----------------------------------------------------------
+
+    def _properties(self) -> List[Tuple[str, str, Optional[str]]]:
+        """(expectation, name, encoded discovery path) triples
+        (explorer.rs:187-205)."""
+        checker = self._checker
+        discoveries = checker.discoveries()
+        return [
+            (
+                _EXPECTATION_NAMES[p.expectation],
+                p.name,
+                discoveries[p.name].encode() if p.name in discoveries else None,
+            )
+            for p in checker.model().properties()
+        ]
+
+    def _state_view(
+        self, model, action, outcome, state, fps: List[int], properties=None
+    ) -> dict:
+        view = {
+            "state": _pretty(state),
+            "fingerprint": str(fps[-1]),
+            "properties": self._properties() if properties is None else properties,
+        }
+        if action is not None:
+            view["action"] = action
+        if outcome is not None:
+            view["outcome"] = outcome
+        # Replaying the whole path (required to build the Path that as_svg
+        # consumes) is only worth it when the model actually overrides the
+        # core no-op as_svg (core.py:90).
+        from ..core import Model as _BaseModel
+
+        if type(model).as_svg is not _BaseModel.as_svg:
+            try:
+                svg = model.as_svg(Path.from_fingerprints(model, fps))
+            except Exception:
+                svg = None
+            if svg is not None:
+                view["svg"] = svg
+        return view
+
+
+def _pretty(state: Any) -> str:
+    """A multi-line state rendering (the analogue of Rust's ``{:#?}``,
+    explorer.rs:49)."""
+    try:
+        import pprint
+
+        return pprint.pformat(state, width=60)
+    except Exception:
+        return repr(state)
+
+
+def serve(builder, addresses):
+    """Starts the Explorer web service; blocks forever (checker.rs:137-144).
+
+    ``addresses`` is a ``"host:port"`` string or ``(host, port)`` tuple.
+    Returns the checker (for tests that build the service without blocking,
+    use :func:`make_app`).
+    """
+    app, checker = make_app(builder)
+    host, port = _parse_address(addresses)
+
+    class Handler(_ExplorerHandler):
+        explorer_app = app
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=_rearm_loop, args=(app,), daemon=True).start()
+    threading.Thread(target=_drive_loop, args=(app,), daemon=True).start()
+    print(f"Exploring. http://{host}:{port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return checker
+
+
+def make_app(builder):
+    """Builds the Explorer app + demand-driven checker without binding a
+    socket (the test entry point, mirroring explorer.rs:314-351)."""
+    snapshot = Snapshot()
+    checker = builder.visitor(snapshot.visit).spawn_on_demand()
+    return ExplorerApp(checker, snapshot), checker
+
+
+def _rearm_loop(app: ExplorerApp) -> None:
+    while True:
+        time.sleep(4)
+        app._snapshot.rearm()
+
+
+def _drive_loop(app: ExplorerApp) -> None:
+    """Advances the checker once unblocked — the reference's worker threads
+    do this; here a single background thread suffices."""
+    while True:
+        time.sleep(0.05)
+        app.drive()
+
+
+def _parse_address(addresses) -> Tuple[str, int]:
+    if isinstance(addresses, (tuple, list)):
+        host, port = addresses
+        return str(host), int(port)
+    host, _, port = str(addresses).rpartition(":")
+    return host or "localhost", int(port)
+
+
+class _ExplorerHandler(BaseHTTPRequestHandler):
+    explorer_app: ExplorerApp = None  # injected by serve()
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: Any) -> None:
+        self._send(code, json.dumps(payload).encode(), "application/json")
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0]
+        if path == "/.status":
+            self._send_json(200, self.explorer_app.status())
+        elif path.startswith("/.states"):
+            code, body = self.explorer_app.states(path[len("/.states"):])
+            if code == 200:
+                self._send_json(200, body)
+            else:
+                self._send(code, str(body).encode(), "text/plain")
+        elif path in _UI_FILES:
+            name, content_type = _UI_FILES[path]
+            dev = FsPath("./ui") / name
+            f = dev if dev.exists() else _UI_DIR / name
+            if f.exists():
+                self._send(200, f.read_bytes(), content_type)
+            else:
+                self._send(404, b"missing UI file", "text/plain")
+        else:
+            self._send(404, b"not found", "text/plain")
+
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0]
+        if path == "/.runtocompletion":
+            self.explorer_app.run_to_completion()
+            self._send_json(200, None)
+        else:
+            self._send(404, b"not found", "text/plain")

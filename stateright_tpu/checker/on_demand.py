@@ -1,0 +1,82 @@
+"""Demand-driven checker: computes nothing until asked.
+
+Mirrors ``/root/reference/src/checker/on_demand.rs``: a BFS-like engine that
+starts with only the initial states pending and **blocks until asked**.
+``check_fingerprint(fp)`` (ControlFlow::CheckFingerprint,
+on_demand.rs:165-203, 460-465) evaluates and expands exactly the pending
+frontier entry with that fingerprint; ``run_to_completion()``
+(ControlFlow::RunToCompletion) unblocks the engine fully, after which it
+behaves like the batch BFS checker. The Explorer is built on this so the UI
+only computes the states the user clicks.
+
+Design delta from the reference: the reference fans control messages over an
+mpsc channel to waiting worker threads and reuses ``check_block`` (so one
+click may expand up to 1500 states of the clicked subtree); this engine is
+in-process and expands exactly the requested entry per request — the
+demand-driven contract the Explorer actually relies on. A ``join()`` before
+``run_to_completion()`` would deadlock in the reference (workers wait on the
+channel forever); here it raises instead of hanging.
+"""
+
+from __future__ import annotations
+
+from .search import SearchChecker
+
+
+class OnDemandChecker(SearchChecker):
+    """Spawned via ``CheckerBuilder.spawn_on_demand()`` (checker.rs:163-171)."""
+
+    def __init__(self, builder):
+        super().__init__(builder, lifo=False)
+        self._waiting = True
+
+    # --- control flow (checker.rs:259-266) --------------------------------
+
+    def check_fingerprint(self, fingerprint: int) -> None:
+        """Evaluates and expands the pending frontier entry with this
+        fingerprint, if any (on_demand.rs:460-465). Unknown or already
+        processed fingerprints are ignored, as in the reference."""
+        if not self._waiting:
+            return
+        for i, entry in enumerate(self._pending):
+            if entry[1] == fingerprint:
+                del self._pending[i]
+                self._evaluate_and_expand(*entry)
+                return
+
+    def run_to_completion(self) -> None:
+        """Unblocks the engine; subsequent ``join()``/``report()`` drive it
+        to completion like a batch BFS (on_demand.rs:193-198)."""
+        self._waiting = False
+
+    # --- Checker API adjustments ------------------------------------------
+
+    def _run_block(self, max_count: int = 1500) -> None:
+        if self._waiting:
+            return  # computes nothing until asked (on_demand.rs:165-203)
+        super()._run_block(max_count)
+
+    def is_done(self) -> bool:
+        if self._waiting:
+            # While demand-driven, the search is done when every property
+            # has a discovery, the driven frontier ran dry, or a target was
+            # hit — never merely because the un-driven frontier is non-empty.
+            return (
+                not self._pending
+                or self._target_reached
+                or (
+                    bool(self._properties)
+                    and len(self._discoveries) == len(self._properties)
+                )
+            )
+        return super().is_done()
+
+    def join(self) -> "OnDemandChecker":
+        if self._waiting and not self.is_done():
+            # The reference would block forever here (workers wait on the
+            # control channel); fail loudly instead.
+            raise RuntimeError(
+                "join() on an on-demand checker that was never unblocked; "
+                "call run_to_completion() first"
+            )
+        return super().join()

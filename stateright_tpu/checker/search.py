@@ -85,9 +85,6 @@ class SearchChecker(Checker):
 
     def _run_block(self, max_count: int = 1500) -> None:
         """Process up to ``max_count`` pending states (bfs.rs:225-383)."""
-        model = self._model
-        properties = self._properties
-        n_props = len(properties)
         while max_count > 0:
             max_count -= 1
             if not self._pending:
@@ -97,83 +94,95 @@ class SearchChecker(Checker):
             # dfs.rs:254 pop); BFS enqueues children on the left
             # (bfs.rs:367 push_front) and DFS on the right (dfs.rs:391 push),
             # reproducing the reference's exact visit order.
-            state, state_fp, ebits, depth = self._pending.pop()
+            if not self._evaluate_and_expand(*self._pending.pop()):
+                return
 
-            if depth > self._max_depth:
-                self._max_depth = depth
-            if self._target_max_depth is not None and depth >= self._target_max_depth:
+    def _evaluate_and_expand(self, state, state_fp, ebits, depth) -> bool:
+        """Evaluate properties on one dequeued state and push its successors.
+
+        The body of the reference's hot loop (bfs.rs:252-381), shared by the
+        batch engines and the demand-driven checker. Returns False when the
+        block should stop (all properties discovered, or target state count
+        reached)."""
+        model = self._model
+        properties = self._properties
+
+        if depth > self._max_depth:
+            self._max_depth = depth
+        if self._target_max_depth is not None and depth >= self._target_max_depth:
+            return True
+
+        if self._visitor is not None:
+            self._visitor.visit(model, self._reconstruct_path(state_fp))
+
+        # Property evaluation on the dequeued state (bfs.rs:279-328).
+        is_awaiting_discoveries = False
+        for i, prop in enumerate(properties):
+            if prop.name in self._discoveries:
                 continue
-
-            if self._visitor is not None:
-                self._visitor.visit(model, self._reconstruct_path(state_fp))
-
-            # Property evaluation on the dequeued state (bfs.rs:279-328).
-            is_awaiting_discoveries = False
-            for i, prop in enumerate(properties):
-                if prop.name in self._discoveries:
-                    continue
-                if prop.expectation == Expectation.ALWAYS:
-                    if not prop.condition(model, state):
-                        self._discoveries[prop.name] = state_fp
-                    else:
-                        is_awaiting_discoveries = True
-                elif prop.expectation == Expectation.SOMETIMES:
-                    if prop.condition(model, state):
-                        self._discoveries[prop.name] = state_fp
-                    else:
-                        is_awaiting_discoveries = True
+            if prop.expectation == Expectation.ALWAYS:
+                if not prop.condition(model, state):
+                    self._discoveries[prop.name] = state_fp
                 else:
-                    # Eventually-property discoveries only materialize at
-                    # terminal states, so this property is still awaiting one
-                    # regardless of whether it holds here (bfs.rs:309-323).
                     is_awaiting_discoveries = True
-                    if prop.condition(model, state):
-                        ebits = ebits - {i}
-            if not is_awaiting_discoveries:
-                # Discoveries exist for every property. Like the reference
-                # (bfs.rs:326-328), this is detected after visiting the
-                # dequeued state, so one state is evaluated even when there
-                # are zero properties.
-                return
-
-            # Expansion (bfs.rs:330-381).
-            is_terminal = True
-            actions: List[Any] = []
-            model.actions(state, actions)
-            for action in actions:
-                next_state = model.next_state(state, action)
-                if next_state is None:
-                    continue
-                if not model.within_boundary(next_state):
-                    continue
-                self._state_count += 1
-                next_fp = fingerprint(next_state)
-                rep_fp = self._rep_fp(next_state, next_fp)
-                if rep_fp in self._generated:
-                    # Could be a cycle (terminal for eventually-checking
-                    # purposes) or a DAG join (not terminal); like the
-                    # reference we do not disambiguate, accepting the
-                    # documented false negative (bfs.rs:353-360).
-                    is_terminal = False
-                    continue
-                self._generated.add(rep_fp)
-                if next_fp not in self._parents:
-                    self._parents[next_fp] = state_fp
-                is_terminal = False
-                entry = (next_state, next_fp, ebits, depth + 1)
-                if self._lifo:
-                    self._pending.append(entry)
+            elif prop.expectation == Expectation.SOMETIMES:
+                if prop.condition(model, state):
+                    self._discoveries[prop.name] = state_fp
                 else:
-                    self._pending.appendleft(entry)
-            if is_terminal:
-                for i in ebits:
-                    self._discoveries[properties[i].name] = state_fp
-            if (
-                self._target_state_count is not None
-                and self._state_count >= self._target_state_count
-            ):
-                self._target_reached = True
-                return
+                    is_awaiting_discoveries = True
+            else:
+                # Eventually-property discoveries only materialize at
+                # terminal states, so this property is still awaiting one
+                # regardless of whether it holds here (bfs.rs:309-323).
+                is_awaiting_discoveries = True
+                if prop.condition(model, state):
+                    ebits = ebits - {i}
+        if not is_awaiting_discoveries:
+            # Discoveries exist for every property. Like the reference
+            # (bfs.rs:326-328), this is detected after visiting the
+            # dequeued state, so one state is evaluated even when there
+            # are zero properties.
+            return False
+
+        # Expansion (bfs.rs:330-381).
+        is_terminal = True
+        actions: List[Any] = []
+        model.actions(state, actions)
+        for action in actions:
+            next_state = model.next_state(state, action)
+            if next_state is None:
+                continue
+            if not model.within_boundary(next_state):
+                continue
+            self._state_count += 1
+            next_fp = fingerprint(next_state)
+            rep_fp = self._rep_fp(next_state, next_fp)
+            if rep_fp in self._generated:
+                # Could be a cycle (terminal for eventually-checking
+                # purposes) or a DAG join (not terminal); like the
+                # reference we do not disambiguate, accepting the
+                # documented false negative (bfs.rs:353-360).
+                is_terminal = False
+                continue
+            self._generated.add(rep_fp)
+            if next_fp not in self._parents:
+                self._parents[next_fp] = state_fp
+            is_terminal = False
+            entry = (next_state, next_fp, ebits, depth + 1)
+            if self._lifo:
+                self._pending.append(entry)
+            else:
+                self._pending.appendleft(entry)
+        if is_terminal:
+            for i in ebits:
+                self._discoveries[properties[i].name] = state_fp
+        if (
+            self._target_state_count is not None
+            and self._state_count >= self._target_state_count
+        ):
+            self._target_reached = True
+            return False
+        return True
 
     # --- Checker API ------------------------------------------------------
 

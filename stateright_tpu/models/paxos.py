@@ -198,3 +198,64 @@ def paxos_model(
         .record_msg_in(reg.record_returns)
         .record_msg_out(reg.record_invocations)
     )
+
+
+def main(argv=None) -> None:
+    """CLI mirroring paxos.rs:348-461: ``check``/``explore``/``spawn``."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        client_count = int(args.pop(0)) if args else 2
+        network = Network.from_name(args.pop(0)) if args else None
+        print(f"Model checking Single Decree Paxos with {client_count} clients.")
+        (
+            paxos_model(client_count, 3, network)
+            .checker()
+            .spawn_dfs()
+            .report(WriteReporter())
+        )
+    elif cmd == "explore":
+        client_count = int(args.pop(0)) if args else 2
+        address = args.pop(0) if args else "localhost:3000"
+        network = Network.from_name(args.pop(0)) if args else None
+        print(
+            f"Exploring state space for Single Decree Paxos with "
+            f"{client_count} clients on {address}."
+        )
+        paxos_model(client_count, 3, network).checker().serve(address)
+    elif cmd == "spawn":
+        from ..actor.spawn import json_codec, spawn
+
+        port = 3000
+        ids = [Id.from_addr("127.0.0.1", port + i) for i in range(3)]
+        serialize, deserialize = json_codec(
+            reg.Put, reg.Get, reg.PutOk, reg.GetOk, reg.Internal,
+            Prepare, Prepared, Accept, Accepted, Decided,
+        )
+        print("  A Single Decree Paxos cluster of three servers.")
+        print("  You can interact using netcat:")
+        print(f"$ nc -u localhost {port}")
+        print(serialize(reg.Put(1, "X")).decode())
+        print(serialize(reg.Get(2)).decode())
+        spawn(
+            serialize,
+            deserialize,
+            [
+                (ids[i], PaxosActor([x for x in ids if x != ids[i]]))
+                for i in range(3)
+            ],
+        )
+    else:
+        print("USAGE:")
+        print("  paxos check [CLIENT_COUNT] [NETWORK]")
+        print("  paxos explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
+        print("  paxos spawn")
+        print(f"NETWORK: {' | '.join(Network.names())}")
+
+
+if __name__ == "__main__":
+    main()

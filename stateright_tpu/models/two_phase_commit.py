@@ -323,3 +323,53 @@ class PackedTwoPhaseSys(TwoPhaseSys):
             | jnp.sum(msg_bits << (u16 + shifts), dtype=jnp.uint32)
         )
         return jnp.stack([new_w0, new_w1])
+
+
+def main(argv=None) -> None:
+    """CLI mirroring 2pc.rs:174-255: ``check``/``check-sym``/``check-xla``/
+    ``explore`` subcommands (``check-xla`` is this framework's addition: the
+    same model on the TPU frontier-expansion engine)."""
+    import sys
+
+    from ..report import WriteReporter
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args.pop(0) if args else None
+    if cmd == "check":
+        rm_count = int(args.pop(0)) if args else 2
+        print(f"Checking two phase commit with {rm_count} resource managers.")
+        TwoPhaseSys(rm_count).checker().spawn_dfs().report(WriteReporter())
+    elif cmd == "check-sym":
+        rm_count = int(args.pop(0)) if args else 2
+        print(
+            f"Checking two phase commit with {rm_count} resource managers "
+            f"using symmetry reduction."
+        )
+        TwoPhaseSys(rm_count).checker().symmetry().spawn_dfs().report(
+            WriteReporter()
+        )
+    elif cmd == "check-xla":
+        rm_count = int(args.pop(0)) if args else 2
+        print(
+            f"Checking two phase commit with {rm_count} resource managers "
+            f"on the XLA engine."
+        )
+        PackedTwoPhaseSys(rm_count).checker().spawn_xla().report(WriteReporter())
+    elif cmd == "explore":
+        rm_count = int(args.pop(0)) if args else 2
+        address = args.pop(0) if args else "localhost:3000"
+        print(
+            f"Exploring state space for two phase commit with {rm_count} "
+            f"resource managers on {address}."
+        )
+        TwoPhaseSys(rm_count).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  two-phase-commit check [RM_COUNT]")
+        print("  two-phase-commit check-sym [RM_COUNT]")
+        print("  two-phase-commit check-xla [RM_COUNT]")
+        print("  two-phase-commit explore [RM_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Explorer handler tests, driven without a live HTTP server.
+
+Mirrors the reference's approach of calling the actix handlers directly with
+TestRequest (explorer.rs:314-588): init-state views, next-state JSON with
+fingerprints, ignored actions, 404s on bad fingerprint paths, status smoke
+test, and run-to-completion.
+"""
+
+from typing import Any, List, Optional
+
+from stateright_tpu.checker.explorer import make_app
+from stateright_tpu.core import Model, Property
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.test_util import BinaryClock
+
+
+class _WithIgnoredAction(Model):
+    """0 -> 1 via "go"; "stuck" is always proposed but always ignored."""
+
+    def init_states(self) -> List[int]:
+        return [0]
+
+    def actions(self, state: int, actions: List[Any]) -> None:
+        actions.extend(["go", "stuck"])
+
+    def next_state(self, state: int, action: Any) -> Optional[int]:
+        if action == "go" and state == 0:
+            return 1
+        return None
+
+    def properties(self) -> List[Property]:
+        return [Property.sometimes("reaches 1", lambda _m, s: s == 1)]
+
+
+def test_init_states_view():
+    app, _checker = make_app(BinaryClock().checker())
+    code, body = app.states("/")
+    assert code == 200
+    assert len(body) == 2
+    for view, state in zip(body, (0, 1)):
+        assert view["state"] == repr(state)
+        assert view["fingerprint"] == str(fingerprint(state))
+        assert "action" not in view
+        # (expectation, name, discovery) triples
+        assert view["properties"][0][0] == "Always"
+        assert view["properties"][0][1] == "in [0, 1]"
+
+
+def test_next_states_view_includes_actions_and_outcomes():
+    model = BinaryClock()
+    app, _checker = make_app(model.checker())
+    fp0 = fingerprint(0)
+    code, body = app.states(f"/{fp0}")
+    assert code == 200
+    assert len(body) == 1
+    (view,) = body
+    assert view["action"] == "GoHigh"
+    assert view["fingerprint"] == str(fingerprint(1))
+    assert view["outcome"] is not None
+
+
+def test_ignored_actions_are_reported_without_state():
+    app, _checker = make_app(_WithIgnoredAction().checker())
+    code, body = app.states(f"/{fingerprint(0)}")
+    assert code == 200
+    # "go" produces a state; "stuck" is ignored but still listed
+    # (explorer.rs:292-300).
+    # Default format_action is repr (lib.rs:224-230 analogue).
+    assert [v["action"] for v in body] == ["'go'", "'stuck'"]
+    assert "fingerprint" in body[0]
+    assert "fingerprint" not in body[1]
+    assert "state" not in body[1]
+
+
+def test_unparseable_fingerprints_404():
+    app, _checker = make_app(BinaryClock().checker())
+    code, body = app.states("/not-a-number")
+    assert code == 404
+    assert "Unable to parse" in body
+
+
+def test_unknown_fingerprint_404():
+    app, _checker = make_app(BinaryClock().checker())
+    code, body = app.states("/123456789")
+    assert code == 404
+    assert "Unable to find state" in body
+
+
+def test_status_reflects_demand_driven_progress():
+    app, checker = make_app(TwoPhaseSys(2).checker())
+    status = app.status()
+    assert status["model"] == "TwoPhaseSys"
+    assert status["done"] is False
+    assert status["state_count"] == 1  # only the init state so far
+    names = [p[1] for p in status["properties"]]
+    assert names == [p.name for p in TwoPhaseSys(2).properties()]
+
+    # Walking init states asks the checker to expand them on demand.
+    app.states("/")
+    assert app.status()["state_count"] >= status["state_count"]
+
+
+def test_run_to_completion_finishes_via_drive():
+    app, checker = make_app(TwoPhaseSys(2).checker())
+    app.run_to_completion()
+    while not checker.is_done():
+        app.drive()
+    status = app.status()
+    assert status["done"] is True
+    bfs = TwoPhaseSys(2).checker().spawn_bfs().join()
+    assert status["unique_state_count"] == bfs.unique_state_count()
+    # Discovered "sometimes" properties carry an encoded path usable as a
+    # /.states URL (explorer.rs:187-205).
+    discovered = [p for p in status["properties"] if p[2] is not None]
+    assert discovered
+    code, _body = app.states("/" + discovered[0][2])
+    assert code == 200
+
+
+def test_recent_path_snapshot_populates():
+    app, checker = make_app(TwoPhaseSys(2).checker())
+    app.run_to_completion()
+    app.drive()
+    assert app.status()["recent_path"] is not None
