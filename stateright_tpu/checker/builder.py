@@ -50,13 +50,18 @@ class CheckerBuilder:
             ) from e
         return OnDemandChecker(self)
 
-    def spawn_xla(self, **kwargs) -> Checker:
+    def spawn_xla(self, *, mesh=None, **kwargs) -> Checker:
         """TPU/XLA frontier-expansion engine: the whole BFS frontier is
         expanded per device super-step with vmapped packed transitions,
         device-resident hash-set dedup, and fused property evaluation.
 
         Requires the model to implement the :class:`PackedModel` protocol
         (see ``stateright_tpu.xla`` for the contract).
+
+        With ``mesh`` (a ``jax.sharding.Mesh`` with one axis, more than one
+        device), the frontier and visited set shard by fingerprint ownership
+        over the mesh with all-to-all routing per super-step
+        (``stateright_tpu.parallel``).
         """
         try:
             from ..xla import XlaChecker
@@ -64,6 +69,11 @@ class CheckerBuilder:
             raise NotImplementedError(
                 "spawn_xla() is not available yet in this build"
             ) from e
+        if mesh is not None and mesh.devices.size > 1:
+            from ..parallel import ShardedXlaChecker
+
+            return ShardedXlaChecker(self, mesh, **kwargs)
+        kwargs.pop("route_capacity", None)  # sharded-only tuning knob
         return XlaChecker(self, **kwargs)
 
     def serve(self, addresses) -> Checker:

@@ -1,0 +1,23 @@
+"""Multi-chip scale-out for the XLA checker.
+
+The reference scales with worker threads sharing a ``DashMap`` visited set
+(``/root/reference/src/checker/bfs.rs:29-31, 89-211``). On a TPU slice the
+equivalents are collectives over the ICI mesh (SURVEY.md §2.8):
+
+- the **frontier** is sharded over the mesh's one axis,
+- the **visited hash set** is sharded by *fingerprint ownership* — every
+  64-bit fingerprint has exactly one owner shard, so dedup needs no locks
+  and no replication,
+- candidate states are routed to their owner with one ``all_to_all`` per
+  super-step, and
+- counters/discovery flags combine with ``psum`` (the analogue of the
+  reference's shared atomics, bfs.rs:27-28).
+
+Because children live wherever their fingerprint lands, frontier load
+balances itself by hash uniformity — the data-parallel replacement for the
+reference's work-sharing job market.
+"""
+
+from .sharded import ShardedXlaChecker, default_mesh
+
+__all__ = ["ShardedXlaChecker", "default_mesh"]

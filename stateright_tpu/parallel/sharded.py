@@ -1,0 +1,600 @@
+"""Fingerprint-sharded frontier expansion over a ``jax.sharding.Mesh``.
+
+One super-step per BFS level, run as a single ``shard_map``-ped program:
+
+1. each shard evaluates properties over its local frontier rows and expands
+   its local action grid (same fused kernels as the single-chip engine);
+2. candidates are fingerprinted and assigned an **owner shard** from the
+   fingerprint bits;
+3. one ``all_to_all`` routes every candidate (state words + fingerprint +
+   parent fingerprint + eventually-bits) to its owner;
+4. the owner inserts into its local partition of the visited hash set —
+   dedup is lock-free because exactly one shard can ever see a given
+   fingerprint (vs. the insert-if-vacant race of bfs.rs:349-363);
+5. newly-inserted states *are* the owner's next local frontier (children
+   live where their fingerprint lives, so no return routing is needed);
+6. counters and discovery flags combine with ``psum``/max.
+
+Capacities (frontier rows per shard, table slots per shard, routing slots
+per destination) are static per compiled program; overflow of any of them
+sets a flag and the host grows the overflowing buffer and re-runs the same
+level — safe because the step is functional.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..checker.base import Checker
+from ..core import Expectation, Model
+from ..ops import fphash, hashset
+from ..xla import XlaChecker, _require_packed
+
+# Owner mix constants: decorrelated from both the fingerprint lanes and the
+# hash-set slot mix (ops/hashset.py:76) so shard choice, slot choice, and
+# identity are pairwise independent.
+_OWNER_MULT = 0x7FEB352D
+
+
+def _owner_bits(fp_hi, fp_lo, n_shards: int, xp):
+    u = xp.uint32
+    mixed = (fp_lo ^ (fp_hi * u(_OWNER_MULT))) >> u(5)
+    return (mixed % u(n_shards)).astype(xp.int32)
+
+
+def default_mesh(n_devices: Optional[int] = None):
+    """A 1-D ``Mesh`` over the first ``n_devices`` devices (all by default),
+    with the axis name the engine expects."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("shards",))
+
+
+class ShardedXlaChecker(Checker):
+    """Level-synchronous BFS sharded over a device mesh.
+
+    Spawn via ``model.checker().spawn_xla(mesh=mesh)``; with a 1-device mesh
+    (or none) ``spawn_xla`` falls back to the single-chip engine.
+    """
+
+    def __init__(
+        self,
+        builder,
+        mesh,
+        *,
+        frontier_capacity: int = 1 << 15,
+        table_capacity: int = 1 << 20,
+        route_capacity: Optional[int] = None,
+        max_probes: int = 32,
+    ):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model = builder._model
+        _require_packed(model)
+        self._model = model
+        self._mesh = mesh
+        self._D = mesh.devices.size
+        if self._D & (self._D - 1):
+            raise ValueError(f"mesh size must be a power of two, got {self._D}")
+        self._symmetry = builder._symmetry is not None
+        if self._symmetry and not hasattr(model, "packed_representative"):
+            raise TypeError(
+                f"symmetry reduction under spawn_xla() requires "
+                f"{type(model).__name__}.packed_representative"
+            )
+        self._target_state_count = builder._target_state_count
+        self._target_max_depth = builder._target_max_depth
+        self._visitor = builder._visitor
+        self._properties = model.properties()
+        self._prop_names = [p.name for p in self._properties]
+        self._ebit_of_prop: Dict[int, int] = {}
+        for i, p in enumerate(self._properties):
+            if p.expectation == Expectation.EVENTUALLY:
+                self._ebit_of_prop[i] = len(self._ebit_of_prop)
+        self._ebits0 = (1 << len(self._ebit_of_prop)) - 1
+
+        self._max_probes = max_probes
+        self._W = model.state_words
+        self._A = model.max_actions
+        self._P = len(self._properties)
+
+        D = self._D
+        self._Fl = max(frontier_capacity // D, 16)  # frontier rows per shard
+        self._Cl = max(table_capacity // D, 64)  # table slots per shard
+        if self._Cl & (self._Cl - 1):
+            raise ValueError("table_capacity/D must be a power of two")
+        # Routing slots per (src, dst) pair. Hash uniformity spreads each
+        # shard's candidates evenly over destinations; 4x slack + retry on
+        # overflow covers skew.
+        local_cand = self._Fl * self._A
+        self._K = route_capacity or min(local_cand, max(64, (local_cand // D) * 4))
+
+        self._row_spec = P("shards", None)
+        self._plane_spec = P("shards")
+        self._row_sharding = NamedSharding(mesh, self._row_spec)
+        self._plane_sharding = NamedSharding(mesh, self._plane_spec)
+        self._rep_sharding = NamedSharding(mesh, P())
+
+        # --- initial device state ----------------------------------------
+        init_packed = np.asarray(model.packed_init(), dtype=np.uint32)
+        keep = [model.within_boundary(model.unpack(row)) for row in init_packed]
+        init_packed = init_packed[keep]
+        n_init = len(init_packed)
+
+        # Route init states to their owner shard host-side.
+        dedup_init = self._dedup_words_host(init_packed)
+        ihi, ilo = fphash.fingerprint_words(dedup_init, np)
+        owners = _owner_bits(ihi, ilo, D, np)
+        frontier = np.zeros((D, self._Fl, self._W), dtype=np.uint32)
+        fhi = np.zeros((D, self._Fl), dtype=np.uint32)
+        flo = np.zeros((D, self._Fl), dtype=np.uint32)
+        counts = np.zeros((D,), dtype=np.int32)
+        for row, hi, lo, owner in zip(init_packed, ihi, ilo, owners):
+            i = counts[owner]
+            if i >= self._Fl:
+                raise ValueError("frontier_capacity too small for init states")
+            frontier[owner, i] = row
+            fhi[owner, i] = hi
+            flo[owner, i] = lo
+            counts[owner] += 1
+
+        self._frontier = jax.device_put(
+            frontier.reshape(D * self._Fl, self._W), self._row_sharding
+        )
+        ebits = np.zeros((D, self._Fl), dtype=np.uint32)
+        for d in range(D):
+            ebits[d, : counts[d]] = self._ebits0
+        self._frontier_ebits = jax.device_put(
+            ebits.reshape(D * self._Fl), self._plane_sharding
+        )
+        self._counts = jax.device_put(counts, self._plane_sharding)
+
+        table = hashset.make(D * self._Cl, jnp)
+        self._table = hashset.HashSet(
+            *(jax.device_put(p, self._plane_sharding) for p in table)
+        )
+        # Insert init fingerprints (shard-local batches, zero parents).
+        ins = self._sharded_init_insert()
+        planes, n_unique_init = ins(
+            tuple(self._table),
+            jax.device_put(fhi.reshape(-1), self._plane_sharding),
+            jax.device_put(flo.reshape(-1), self._plane_sharding),
+            self._counts,
+        )
+        self._table = hashset.HashSet(*planes)
+        self._disc_found = jax.device_put(
+            jnp.zeros(self._P, jnp.bool_), self._rep_sharding
+        )
+        self._disc_fp = jax.device_put(
+            jnp.zeros((self._P, 2), jnp.uint32), self._rep_sharding
+        )
+
+        self._depth = 1
+        self._max_depth = 0
+        self._state_count = n_init
+        self._unique_count = int(n_unique_init)
+        self._found_names: Dict[str, int] = {}
+        self._exhausted = n_init == 0
+        self._target_reached = False
+        self._step_cache: Dict[Any, Any] = {}
+
+    # --- host helpers (shared semantics with the single-chip engine) ------
+
+    _dedup_words_host = XlaChecker._dedup_words_host
+    _packed_fp64 = XlaChecker._packed_fp64
+    _parent_map = XlaChecker._parent_map
+    _path_for = XlaChecker._path_for
+
+    # --- device programs ---------------------------------------------------
+
+    def _shard_map(self, fn, in_specs, out_specs):
+        import jax
+
+        if hasattr(jax, "shard_map"):  # jax >= 0.8
+            smap = jax.shard_map(
+                fn,
+                mesh=self._mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=False,
+            )
+        else:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+            smap = shard_map(
+                fn,
+                mesh=self._mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_rep=False,
+            )
+        return jax.jit(smap)
+
+    def _sharded_init_insert(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        Fl, max_probes = self._Fl, self._max_probes
+
+        def body(table, fhi, flo, count):
+            active = jnp.arange(Fl) < count[0]
+            table, is_new, ovf = hashset.insert(
+                hashset.HashSet(*table),
+                fhi,
+                flo,
+                jnp.zeros(Fl, jnp.uint32),
+                jnp.zeros(Fl, jnp.uint32),
+                active,
+                max_probes=max_probes,
+            )
+            unique = jax.lax.psum(jnp.sum(is_new, dtype=jnp.int32), "shards")
+            return tuple(table), unique
+
+        return self._shard_map(
+            body,
+            in_specs=((P("shards"),) * 4, P("shards"), P("shards"), P("shards")),
+            out_specs=((P("shards"),) * 4, P()),
+        )
+
+    def _build_superstep(self, Fl: int, Cl: int, K: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        model = self._model
+        prop_specs = [(i, p.expectation) for i, p in enumerate(self._properties)]
+        ebit_of_prop = dict(self._ebit_of_prop)
+        symmetry = self._symmetry
+        A, W, D = self._A, self._W, self._D
+        P_count = self._P
+        max_probes = self._max_probes
+        LANES = W + 5  # state words + fp_hi, fp_lo, par_hi, par_lo, ebits
+
+        def dedup_words(words):
+            return model.packed_representative(words) if symmetry else words
+
+        def pick_discovery(disc_found, disc_fp, i, viol, fhi, flo):
+            """Elect one witness fingerprint across shards: the local first
+            match, combined by pmax (the reference lets threads race here,
+            bfs.rs:291-306; pmax is simply a deterministic tiebreak)."""
+            has_local = jnp.any(viol)
+            first = jnp.argmax(viol)
+            cand_hi = jnp.where(has_local, fhi[first], jnp.uint32(0))
+            cand_lo = jnp.where(has_local, flo[first], jnp.uint32(0))
+            g_hi = jax.lax.pmax(cand_hi, "shards")
+            is_max_shard = cand_hi == g_hi
+            g_lo = jax.lax.pmax(
+                jnp.where(is_max_shard, cand_lo, jnp.uint32(0)), "shards"
+            )
+            has = jax.lax.pmax(has_local.astype(jnp.uint32), "shards") > 0
+            take = has & ~disc_found[i]
+            disc_fp = disc_fp.at[i, 0].set(jnp.where(take, g_hi, disc_fp[i, 0]))
+            disc_fp = disc_fp.at[i, 1].set(jnp.where(take, g_lo, disc_fp[i, 1]))
+            disc_found = disc_found.at[i].set(disc_found[i] | has)
+            return disc_found, disc_fp
+
+        def superstep(frontier, f_ebits, count, table, disc_found, disc_fp):
+            # Local block shapes: frontier [Fl, W], f_ebits [Fl], count [1],
+            # table planes [Cl], disc_* replicated.
+            f_valid = jnp.arange(Fl) < count[0]
+            dw = jax.vmap(dedup_words)(frontier)
+            fhi, flo = fphash.fingerprint_words(dw, jnp)
+
+            # 1. property evaluation over the local frontier.
+            props = jax.vmap(model.packed_properties)(frontier)  # [Fl, P]
+            for i, expectation in prop_specs:
+                if expectation == Expectation.EVENTUALLY:
+                    bit = jnp.uint32(1 << ebit_of_prop[i])
+                    sat = props[:, i] & f_valid
+                    f_ebits = jnp.where(sat, f_ebits & ~bit, f_ebits)
+                    continue
+                if expectation == Expectation.ALWAYS:
+                    viol = ~props[:, i] & f_valid
+                else:
+                    viol = props[:, i] & f_valid
+                disc_found, disc_fp = pick_discovery(
+                    disc_found, disc_fp, i, viol, fhi, flo
+                )
+
+            # 2. local action-grid expansion.
+            nxt, valid = jax.vmap(model.packed_step)(frontier)  # [Fl,A,W],[Fl,A]
+            valid = valid & f_valid[:, None]
+            step_states = jax.lax.psum(jnp.sum(valid, dtype=jnp.int32), "shards")
+
+            # 3. terminal detection (bfs.rs:374-381) before routing — it
+            #    needs the parent-side successor mask.
+            terminal = f_valid & ~jnp.any(valid, axis=1)
+            for i, expectation in prop_specs:
+                if expectation != Expectation.EVENTUALLY:
+                    continue
+                bit = jnp.uint32(1 << ebit_of_prop[i])
+                viol = terminal & ((f_ebits & bit) != 0)
+                disc_found, disc_fp = pick_discovery(
+                    disc_found, disc_fp, i, viol, fhi, flo
+                )
+
+            # 4. fingerprint candidates and assign owner shards.
+            cand = nxt.reshape(Fl * A, W)
+            cdw = jax.vmap(dedup_words)(cand)
+            chi, clo = fphash.fingerprint_words(cdw, jnp)
+            vflat = valid.reshape(-1)
+            owner = _owner_bits(chi, clo, D, jnp)
+
+            payload = jnp.concatenate(
+                [
+                    cand,
+                    chi[:, None],
+                    clo[:, None],
+                    jnp.broadcast_to(fhi[:, None], (Fl, A)).reshape(-1)[:, None],
+                    jnp.broadcast_to(flo[:, None], (Fl, A)).reshape(-1)[:, None],
+                    jnp.broadcast_to(f_ebits[:, None], (Fl, A)).reshape(-1)[:, None],
+                ],
+                axis=1,
+            )  # [Fl*A, LANES]
+
+            # 5. pack per-destination routing buffers. Inactive slots stay
+            #    all-zero; (0,0) fingerprints mark them empty downstream.
+            buf = jnp.zeros((D, K, LANES), jnp.uint32)
+            route_ovf = jnp.bool_(False)
+            for d in range(D):
+                sel = vflat & (owner == d)
+                pos = jnp.cumsum(sel.astype(jnp.int32)) - 1
+                route_ovf = route_ovf | (jnp.sum(sel, dtype=jnp.int32) > K)
+                idx = jnp.where(sel & (pos < K), pos, K)
+                buf = buf.at[d, idx, :].set(
+                    jnp.where(sel[:, None], payload, 0), mode="drop"
+                )
+            route_ovf = jax.lax.pmax(route_ovf.astype(jnp.uint32), "shards") > 0
+
+            # 6. the all-to-all: slice d of the result came from shard d.
+            recv = jax.lax.all_to_all(
+                buf, "shards", split_axis=0, concat_axis=0, tiled=False
+            )
+            recv = recv.reshape(D * K, LANES)
+            r_state = recv[:, :W]
+            r_hi = recv[:, W]
+            r_lo = recv[:, W + 1]
+            r_par_hi = recv[:, W + 2]
+            r_par_lo = recv[:, W + 3]
+            r_ebits = recv[:, W + 4]
+            r_active = (r_hi != 0) | (r_lo != 0)
+
+            # 7. owner-local dedup insert (no cross-shard races possible).
+            new_table, is_new, ovf = hashset.insert(
+                hashset.HashSet(*table),
+                r_hi,
+                r_lo,
+                r_par_hi,
+                r_par_lo,
+                r_active,
+                max_probes=max_probes,
+            )
+            step_unique = jax.lax.psum(jnp.sum(is_new, dtype=jnp.int32), "shards")
+            table_ovf = jax.lax.pmax(jnp.any(ovf).astype(jnp.uint32), "shards") > 0
+
+            # 8. compact the owner's new states into its next local frontier.
+            pos = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+            new_count = jnp.sum(is_new, dtype=jnp.int32)
+            frontier_ovf = (
+                jax.lax.pmax((new_count > Fl).astype(jnp.uint32), "shards") > 0
+            )
+            idx = jnp.where(is_new & (pos < Fl), pos, Fl)
+            new_frontier = (
+                jnp.zeros((Fl, W), jnp.uint32).at[idx].set(r_state, mode="drop")
+            )
+            new_ebits = jnp.zeros((Fl,), jnp.uint32).at[idx].set(r_ebits, mode="drop")
+
+            return (
+                new_frontier,
+                new_ebits,
+                new_count[None],
+                tuple(new_table),
+                disc_found,
+                disc_fp,
+                step_states,
+                step_unique,
+                table_ovf,
+                frontier_ovf,
+                route_ovf,
+            )
+
+        spec_rows = P("shards", None)
+        spec_plane = P("shards")
+        spec_rep = P()
+        return self._shard_map(
+            superstep,
+            in_specs=(
+                spec_rows,
+                spec_plane,
+                spec_plane,
+                (spec_plane,) * 4,
+                spec_rep,
+                spec_rep,
+            ),
+            out_specs=(
+                spec_rows,
+                spec_plane,
+                spec_plane,
+                (spec_plane,) * 4,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+            ),
+        )
+
+    def _superstep(self):
+        key = (self._Fl, self._Cl, self._K)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build_superstep(*key)
+            self._step_cache[key] = fn
+        return fn
+
+    # --- growth -----------------------------------------------------------
+
+    def _grow_table(self) -> None:
+        """Double every shard's table partition (ownership is capacity-
+        independent, so rehash stays shard-local)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        D, Cl = self._D, self._Cl
+        old = self._table
+        new_Cl = Cl * 2
+        max_probes = self._max_probes
+
+        def rehash(old_planes):
+            kh, kl, vh, vl = old_planes
+            occupied = (kh != 0) | (kl != 0)
+            bigger = hashset.make(new_Cl, jnp)
+            bigger, _, ovf = hashset.insert(
+                bigger, kh, kl, vh, vl, occupied, max_probes=max_probes
+            )
+            # rank-1 so the per-shard scalar shards over the axis.
+            return tuple(bigger), jnp.any(ovf)[None]
+
+        fn = self._shard_map(
+            rehash,
+            in_specs=((P("shards"),) * 4,),
+            out_specs=((P("shards"),) * 4, P("shards")),
+        )
+        planes, ovf = fn(tuple(old))
+        if bool(np.any(np.asarray(ovf))):  # pragma: no cover
+            raise RuntimeError("rehash overflow — pathological fingerprint distribution")
+        self._table = hashset.HashSet(*planes)
+        self._Cl = new_Cl
+
+    def _grow_frontier(self) -> None:
+        import jax
+
+        D, Fl, W = self._D, self._Fl, self._W
+        new_Fl = Fl * 2
+        rows = np.asarray(self._frontier).reshape(D, Fl, W)
+        ebits = np.asarray(self._frontier_ebits).reshape(D, Fl)
+        grown = np.zeros((D, new_Fl, W), dtype=np.uint32)
+        grown[:, :Fl] = rows
+        gebits = np.zeros((D, new_Fl), dtype=np.uint32)
+        gebits[:, :Fl] = ebits
+        self._frontier = jax.device_put(
+            grown.reshape(D * new_Fl, W), self._row_sharding
+        )
+        self._frontier_ebits = jax.device_put(
+            gebits.reshape(D * new_Fl), self._plane_sharding
+        )
+        self._Fl = new_Fl
+        local_cand = self._Fl * self._A
+        self._K = min(local_cand, max(self._K, (local_cand // self._D) * 4))
+
+    # --- engine ------------------------------------------------------------
+
+    def _run_block(self, max_count: int = 1500) -> None:
+        import numpy as np
+
+        if self._target_reached or self._exhausted:
+            return
+        if self._P > 0 and all(n in self._found_names for n in self._prop_names):
+            return
+        total = int(np.sum(np.asarray(self._counts)))
+        if total == 0:
+            self._exhausted = True
+            return
+        self._max_depth = max(self._max_depth, self._depth)
+        if self._target_max_depth is not None and self._depth >= self._target_max_depth:
+            self._exhausted = True
+            return
+        if self._visitor is not None:
+            self._visit_frontier()
+
+        while True:
+            fn = self._superstep()
+            out = fn(
+                self._frontier,
+                self._frontier_ebits,
+                self._counts,
+                tuple(self._table),
+                self._disc_found,
+                self._disc_fp,
+            )
+            (nf, ne, ncounts, table, dfound, dfp, d_states, d_unique,
+             t_ovf, f_ovf, r_ovf) = out
+            if bool(np.asarray(t_ovf)):
+                self._grow_table()
+                continue
+            if bool(np.asarray(f_ovf)):
+                self._grow_frontier()
+                continue
+            if bool(np.asarray(r_ovf)):
+                self._K = min(self._Fl * self._A, self._K * 2)
+                continue
+            break
+
+        self._frontier, self._frontier_ebits = nf, ne
+        self._counts = ncounts
+        self._table = hashset.HashSet(*table)
+        self._disc_found, self._disc_fp = dfound, dfp
+        self._state_count += int(np.asarray(d_states))
+        self._unique_count += int(np.asarray(d_unique))
+        self._depth += 1
+        found = np.asarray(self._disc_found)
+        fps = np.asarray(self._disc_fp)
+        for i, name in enumerate(self._prop_names):
+            if found[i] and name not in self._found_names:
+                self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
+        if (
+            self._target_state_count is not None
+            and self._state_count >= self._target_state_count
+        ):
+            self._target_reached = True
+
+    def _visit_frontier(self) -> None:
+        rows = np.asarray(self._frontier).reshape(self._D, self._Fl, self._W)
+        counts = np.asarray(self._counts)
+        parents = self._parent_map()
+        for d in range(self._D):
+            for row in rows[d, : counts[d]]:
+                fp = fphash.fingerprint_u64(
+                    self._dedup_words_host(row[None, :])[0], np
+                )
+                self._visitor.visit(self._model, self._path_for(fp, parents))
+
+    # --- Checker API -------------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return self._unique_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    def is_done(self) -> bool:
+        if self._exhausted or self._target_reached:
+            return True
+        if self._P > 0 and all(n in self._found_names for n in self._prop_names):
+            return True
+        return int(np.sum(np.asarray(self._counts))) == 0 and self._state_count > 0
+
+    def discoveries(self):
+        parents = self._parent_map()
+        return {
+            name: self._path_for(fp64, parents)
+            for name, fp64 in self._found_names.items()
+        }
