@@ -25,24 +25,50 @@ import time
 NORTH_STAR = 50_000_000.0
 
 
+def _tpu_available(timeout_s: int = 120) -> bool:
+    """Probe TPU availability in a subprocess: the axon tunnel can HANG
+    (not fail) for many minutes inside jax.devices(), which would eat the
+    whole bench budget. A killed probe counts as unavailable."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        return proc.returncode == 0 and "ok" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     rm = int(os.environ.get("BENCH_RM", "8"))
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    use_tpu = _tpu_available()
     import jax
 
-    try:
-        jax.devices()
+    frontier_pow = int(os.environ.get("BENCH_FRONTIER_POW", "19"))
+    table_pow = int(os.environ.get("BENCH_TABLE_POW", "24"))
+    if use_tpu:
         platform = jax.devices()[0].platform
-    except Exception:  # TPU tunnel unavailable — fall back to CPU
+    else:  # TPU tunnel unavailable — fall back to CPU
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
+    if platform == "cpu":
         rm = min(rm, 6)
+        # The insert's per-round claim buffer is O(table); TPU-sized tables
+        # drown a CPU run. The engine grows the table on demand anyway.
+        frontier_pow = min(frontier_pow, 14)
+        table_pow = min(table_pow, 17)
 
     from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
 
     checker = PackedTwoPhaseSys(rm).checker().spawn_xla(
-        frontier_capacity=1 << int(os.environ.get("BENCH_FRONTIER_POW", "19")),
-        table_capacity=1 << int(os.environ.get("BENCH_TABLE_POW", "24")),
+        frontier_capacity=1 << frontier_pow,
+        table_capacity=1 << table_pow,
     )
     # First block compiles; exclude it from timing but count its states.
     checker._run_block()

@@ -1,0 +1,182 @@
+"""Pallas TPU kernel for the hash-set probe/insert loop.
+
+The default insert (``ops/hashset.py``) is pure XLA: each probe round elects
+slot winners with a commutative scatter-min over an O(capacity) claim
+buffer.  That is bandwidth-proportional to the *table*, which is the right
+trade for huge frontier batches but wasteful for small ones (init seeding,
+demand-driven expansion, shallow levels): a 2^24-slot table pays ~64 MB of
+claim traffic per probe round regardless of batch size.
+
+This kernel is the batch-proportional alternative: one sequential pass over
+the batch with **in-place** table updates (``input_output_aliases``), each
+element probing with dynamic size-1 slices.  Sequential execution makes
+election trivial — earlier batch elements simply win, preserving the
+default insert's lowest-index-wins determinism — and no O(capacity)
+temporary exists at all.  The cost model is scalar probing (VPU scalar path
++ HBM latency), so it wins when ``batch << capacity`` and loses when the
+batch is huge; ``insert_auto`` picks per call site.
+
+Correctness is covered by differential tests against ``hashset.insert``
+(CPU interpret mode; the driver's TPU bench exercises the compiled path).
+Results are bit-identical whenever no lane overflows; under overflow the
+two engines may fail *different* elements (parallel election vs. sequential
+fill) — immaterial because every caller discards results and grows the
+table on any overflow.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .hashset import HashSet
+
+
+def _available() -> bool:
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+def insert_pallas(
+    hs: HashSet,
+    fp_hi,
+    fp_lo,
+    val_hi,
+    val_lo,
+    active,
+    *,
+    max_probes: int = 32,
+    interpret: bool | None = None,
+) -> Tuple[HashSet, "jax.Array", "jax.Array"]:
+    """Drop-in replacement for ``hashset.insert`` (same contract: returns
+    ``(hs', is_new, overflow)``; lowest batch index wins among in-batch
+    duplicates)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        # Compiled lowering is only attempted on TPU; every other backend
+        # (cpu, gpu) runs the interpreter — the kernel's scalar dynamic
+        # indexing and ANY-space refs are Mosaic-oriented shapes.
+        interpret = jax.default_backend() != "tpu"
+
+    cap = hs.capacity
+    m = fp_hi.shape[0]
+
+    def kernel(
+        fp_hi_ref,
+        fp_lo_ref,
+        val_hi_ref,
+        val_lo_ref,
+        active_ref,
+        kh_in,
+        kl_in,
+        vh_in,
+        vl_in,
+        kh,
+        kl,
+        vh,
+        vl,
+        is_new_ref,
+        ovf_ref,
+    ):
+        del kh_in, kl_in, vh_in, vl_in  # aliased to kh/kl/vh/vl outputs
+
+        def body(i, _):
+            f_hi = fp_hi_ref[i]
+            f_lo = fp_lo_ref[i]
+            is_active = active_ref[i]
+            slot0 = (f_hi ^ (f_lo * jnp.uint32(0x9E3779B1))) & jnp.uint32(cap - 1)
+
+            def probe(carry):
+                slot, j, done, new, of = carry
+                k_hi = kh[slot]
+                k_lo = kl[slot]
+                occupied = (k_hi != 0) | (k_lo != 0)
+                match = occupied & (k_hi == f_hi) & (k_lo == f_lo)
+                claim = ~occupied
+                done2 = match | claim
+                new2 = claim
+                slot2 = jnp.where(
+                    done2, slot, (slot + jnp.uint32(1)) & jnp.uint32(cap - 1)
+                )
+                return slot2, j + 1, done2, new2, of
+
+            def probe_cond(carry):
+                _slot, j, done, _new, _of = carry
+                return ~done & (j < max_probes)
+
+            slot, j, done, new, _ = jax.lax.while_loop(
+                probe_cond,
+                probe,
+                (slot0, jnp.int32(0), ~is_active, jnp.bool_(False), jnp.bool_(False)),
+            )
+
+            @pl.when(is_active & new)
+            def _():
+                kh[slot] = f_hi
+                kl[slot] = f_lo
+                vh[slot] = val_hi_ref[i]
+                vl[slot] = val_lo_ref[i]
+
+            is_new_ref[i] = is_active & new
+            ovf_ref[i] = is_active & ~done
+            return 0
+
+        jax.lax.fori_loop(0, m, body, 0)
+
+    out_shapes = (
+        jax.ShapeDtypeStruct((cap,), jnp.uint32),  # kh
+        jax.ShapeDtypeStruct((cap,), jnp.uint32),  # kl
+        jax.ShapeDtypeStruct((cap,), jnp.uint32),  # vh
+        jax.ShapeDtypeStruct((cap,), jnp.uint32),  # vl
+        jax.ShapeDtypeStruct((m,), jnp.bool_),  # is_new
+        jax.ShapeDtypeStruct((m,), jnp.bool_),  # overflow
+    )
+    spec = pl.BlockSpec(memory_space=pl.ANY) if not interpret else pl.BlockSpec()
+    specs = [pl.BlockSpec()] * 5 + [spec] * 4
+
+    kh, kl, vh, vl, is_new, ovf = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        in_specs=specs,
+        out_specs=(spec, spec, spec, spec, pl.BlockSpec(), pl.BlockSpec()),
+        # Table planes update in place: inputs 5..8 alias outputs 0..3.
+        input_output_aliases={5: 0, 6: 1, 7: 2, 8: 3},
+        interpret=interpret,
+    )(fp_hi, fp_lo, val_hi, val_lo, active, *hs)
+    return HashSet(kh, kl, vh, vl), is_new, ovf
+
+
+def insert_auto(hs, fp_hi, fp_lo, val_hi, val_lo, active, *, max_probes: int = 32):
+    """Batch-size dispatch: the sequential Pallas kernel when the batch is
+    tiny relative to the table (claim traffic would dominate), the XLA
+    scatter-election insert otherwise.
+
+    On TPU the *compiled* kernel is opt-in (``STATERIGHT_TPU_PALLAS=1``)
+    until its Mosaic lowering is validated on hardware; any lowering failure
+    falls back to the XLA insert, so callers never see the difference.
+    """
+    import os
+
+    import jax
+
+    from . import hashset
+
+    m = fp_hi.shape[0]
+    on_tpu = jax.default_backend() == "tpu"
+    enabled = not on_tpu or os.environ.get("STATERIGHT_TPU_PALLAS") == "1"
+    if _available() and enabled and m * 64 < hs.capacity:
+        try:
+            return insert_pallas(
+                hs, fp_hi, fp_lo, val_hi, val_lo, active, max_probes=max_probes
+            )
+        except Exception:  # pragma: no cover - TPU lowering gaps
+            pass
+    return hashset.insert(
+        hs, fp_hi, fp_lo, val_hi, val_lo, active, max_probes=max_probes
+    )

@@ -130,10 +130,14 @@ class XlaChecker(Checker):
         self._frontier_capacity = max(frontier_capacity, 1 << max(n_init.bit_length(), 4))
         self._table = hashset.make(table_capacity, jnp)
         # Insert init fingerprints with a zero parent (the "no predecessor"
-        # marker, like the None predecessor of bfs.rs:59-65).
+        # marker, like the None predecessor of bfs.rs:59-65). Tiny batch vs
+        # the full table: insert_auto takes the batch-proportional Pallas
+        # kernel on accelerators rather than the claim-buffer election.
+        from .ops.pallas_hashset import insert_auto
+
         dedup_init = self._dedup_words_host(init_packed)
         ihi, ilo = fphash.fingerprint_words(dedup_init, np)
-        self._table, is_new, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
+        self._table, is_new, ovf = insert_auto(
             self._table,
             jnp.asarray(ihi),
             jnp.asarray(ilo),

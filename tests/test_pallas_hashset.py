@@ -1,0 +1,107 @@
+"""Pallas insert kernel: differential tests against the XLA scatter insert.
+
+Runs in interpret mode on CPU (the kernel auto-detects backend); the
+contract is bit-identical results — same is_new/overflow flags and the same
+table contents — for any batch, including in-batch duplicates, inactive
+lanes, and overflow.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stateright_tpu.ops import hashset
+from stateright_tpu.ops.pallas_hashset import insert_auto, insert_pallas
+
+
+def _table_set(t):
+    kh, kl, vh, vl = (np.asarray(p) for p in t)
+    occ = (kh != 0) | (kl != 0)
+    return set(zip(kh[occ], kl[occ], vh[occ], vl[occ]))
+
+
+def _random_batch(n, seed, dup_every=0, inactive_frac=0.0):
+    rng = np.random.default_rng(seed)
+    fp_hi = rng.integers(1, 2**32, size=n, dtype=np.uint32)
+    fp_lo = rng.integers(1, 2**32, size=n, dtype=np.uint32)
+    if dup_every:
+        for i in range(dup_every, n, dup_every):
+            fp_hi[i] = fp_hi[i - dup_every]
+            fp_lo[i] = fp_lo[i - dup_every]
+    vals = np.arange(1, n + 1, dtype=np.uint32)
+    active = rng.random(n) >= inactive_frac
+    return (
+        jnp.asarray(fp_hi),
+        jnp.asarray(fp_lo),
+        jnp.asarray(vals),
+        jnp.asarray(vals),
+        jnp.asarray(active),
+    )
+
+
+@pytest.mark.parametrize("dup_every,inactive_frac", [(0, 0.0), (7, 0.2), (1, 0.5)])
+def test_pallas_matches_xla_insert(dup_every, inactive_frac):
+    batch = _random_batch(200, seed=3, dup_every=dup_every, inactive_frac=inactive_frac)
+    a1, new1, ovf1 = hashset.insert(hashset.make(2048, jnp), *batch)
+    a2, new2, ovf2 = insert_pallas(hashset.make(2048, jnp), *batch)
+    np.testing.assert_array_equal(np.asarray(new1), np.asarray(new2))
+    np.testing.assert_array_equal(np.asarray(ovf1), np.asarray(ovf2))
+    assert _table_set(a1) == _table_set(a2)
+
+
+def test_pallas_duplicate_reinsert_not_new():
+    batch = _random_batch(64, seed=4)
+    hs, new1, _ = insert_pallas(hashset.make(512, jnp), *batch)
+    hs, new2, _ = insert_pallas(hs, *batch)
+    assert int(np.asarray(new1).sum()) == 64
+    assert int(np.asarray(new2).sum()) == 0
+
+
+def test_pallas_overflow_reported():
+    # 16-slot table, 32 distinct keys, max_probes 4: overflow must fire in
+    # both engines. WHICH elements overflow legitimately differs (parallel
+    # election vs. sequential fill); both engines discard results and grow
+    # on any overflow, so only the any() signal is contractual.
+    batch = _random_batch(32, seed=5)
+    _, _, ovf_x = hashset.insert(hashset.make(16, jnp), *batch, max_probes=4)
+    hs_p, _, ovf_p = insert_pallas(hashset.make(16, jnp), *batch, max_probes=4)
+    assert bool(np.asarray(ovf_p).any())
+    assert bool(np.asarray(ovf_x).any())
+    # Whatever did land in the table is a subset of the batch keys.
+    batch_keys = set(zip(np.asarray(batch[0]), np.asarray(batch[1])))
+    assert {(k[0], k[1]) for k in _table_set(hs_p)} <= batch_keys
+
+
+def test_insert_auto_dispatches_small_batch_to_pallas(monkeypatch):
+    import stateright_tpu.ops.pallas_hashset as ph
+
+    called = {}
+
+    def spy(*args, **kwargs):
+        called["pallas"] = True
+        return insert_pallas(*args, **kwargs)
+
+    monkeypatch.setattr(ph, "insert_pallas", spy)
+    batch = _random_batch(32, seed=6)
+    big = hashset.make(1 << 12, jnp)  # 32 * 64 < 4096: pallas path
+    a1, new1, _ = insert_auto(big, *batch)
+    assert called.get("pallas"), "small batch must take the Pallas kernel"
+    a2, new2, _ = hashset.insert(hashset.make(1 << 12, jnp), *batch)
+    np.testing.assert_array_equal(np.asarray(new1), np.asarray(new2))
+    assert _table_set(a1) == _table_set(a2)
+
+
+def test_insert_auto_dispatches_large_batch_to_xla(monkeypatch):
+    import stateright_tpu.ops.pallas_hashset as ph
+
+    def boom(*_a, **_k):  # any pallas call would be a dispatch bug
+        raise AssertionError("large batch must take the XLA insert")
+
+    monkeypatch.setattr(ph, "insert_pallas", boom)
+    batch = _random_batch(128, seed=8)
+    small = hashset.make(1 << 10, jnp)  # 128 * 64 >= 1024: XLA path
+    hs, new, ovf = insert_auto(small, *batch, max_probes=16)
+    assert int(np.asarray(new).sum()) == 128
+    assert not bool(np.asarray(ovf).any())
