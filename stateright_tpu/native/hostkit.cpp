@@ -1,0 +1,153 @@
+// Native host runtime for stateright_tpu.
+//
+// The device engine keeps the visited set in HBM as four uint32 planes
+// (key_hi/key_lo -> parent_hi/parent_lo; see stateright_tpu/ops/hashset.py).
+// Witness reconstruction and checkpointing pull those planes to the host,
+// where the Python fallback builds a dict over every occupied slot — O(n)
+// Python-object churn for tables with millions of entries. This library is
+// the C++ equivalent of the reference's native engine surface
+// (/root/reference is pure Rust; SURVEY.md section 2): an open-addressing
+// index over the raw planes plus chain walking and batch fingerprinting,
+// exposed through a C ABI consumed with ctypes (no pybind11 in this image).
+//
+// Everything here must stay bit-identical with the Python/JAX mirrors:
+// fingerprint_words (ops/fphash.py) and the parent chains the checkers
+// produce; differential tests enforce it.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Two-lane murmur fingerprint, the exact mirror of ops/fphash.py.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
+// words: [n, w] row-major uint32; out_hi/out_lo: [n]
+void fingerprint_words(const uint32_t* words, int64_t n, int64_t w,
+                       uint32_t* out_hi, uint32_t* out_lo) {
+    for (int64_t r = 0; r < n; ++r) {
+        uint32_t hi = 0x9E3779B9u;
+        uint32_t lo = 0x517CC1B7u;
+        const uint32_t* row = words + r * w;
+        for (int64_t i = 0; i < w; ++i) {
+            uint32_t word = row[i];
+            hi = fmix32(hi ^ (word * 0x2545F491u + (uint32_t)(i + 1)));
+            lo = fmix32(lo ^ (word * 0x85157AF5u +
+                              (uint32_t)(0x61C88647u * (uint32_t)(i + 1))));
+        }
+        if (hi == 0 && lo == 0) lo = 1;  // reserve EMPTY sentinel
+        out_hi[r] = hi;
+        out_lo[r] = lo;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Parent map: open-addressing index over the device table planes.
+// ---------------------------------------------------------------------------
+
+struct ParentMap {
+    int64_t capacity;   // power of two
+    uint64_t* keys;     // fp64, 0 == empty
+    uint64_t* parents;  // parent fp64
+    int64_t count;
+};
+
+static inline int64_t pm_slot(uint64_t key, int64_t mask) {
+    // splitmix64 finalizer: uncorrelated with the device slot hash.
+    uint64_t z = key + 0x9E3779B97F4A7C15ull;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    z ^= z >> 31;
+    return (int64_t)(z & (uint64_t)mask);
+}
+
+// Build from the four planes; returns NULL only on allocation failure.
+// Capacity is sized at 2x occupancy rounded up to a power of two.
+ParentMap* parentmap_build(const uint32_t* key_hi, const uint32_t* key_lo,
+                           const uint32_t* val_hi, const uint32_t* val_lo,
+                           int64_t n_slots) {
+    int64_t occupied = 0;
+    for (int64_t i = 0; i < n_slots; ++i)
+        if (key_hi[i] || key_lo[i]) ++occupied;
+    int64_t cap = 64;
+    while (cap < occupied * 2) cap <<= 1;
+
+    ParentMap* pm = (ParentMap*)std::malloc(sizeof(ParentMap));
+    if (!pm) return nullptr;
+    pm->capacity = cap;
+    pm->count = occupied;
+    pm->keys = (uint64_t*)std::calloc((size_t)cap, sizeof(uint64_t));
+    pm->parents = (uint64_t*)std::calloc((size_t)cap, sizeof(uint64_t));
+    if (!pm->keys || !pm->parents) {
+        std::free(pm->keys);
+        std::free(pm->parents);
+        std::free(pm);
+        return nullptr;
+    }
+    int64_t mask = cap - 1;
+    for (int64_t i = 0; i < n_slots; ++i) {
+        if (!(key_hi[i] || key_lo[i])) continue;
+        uint64_t key = ((uint64_t)key_hi[i] << 32) | key_lo[i];
+        uint64_t par = ((uint64_t)val_hi[i] << 32) | val_lo[i];
+        int64_t s = pm_slot(key, mask);
+        while (pm->keys[s] != 0 && pm->keys[s] != key) s = (s + 1) & mask;
+        pm->keys[s] = key;
+        pm->parents[s] = par;
+    }
+    return pm;
+}
+
+void parentmap_free(ParentMap* pm) {
+    if (!pm) return;
+    std::free(pm->keys);
+    std::free(pm->parents);
+    std::free(pm);
+}
+
+int64_t parentmap_count(const ParentMap* pm) { return pm->count; }
+
+// Look up one fingerprint; returns 1 and writes *parent on hit, 0 on miss.
+int parentmap_get(const ParentMap* pm, uint64_t key, uint64_t* parent) {
+    int64_t mask = pm->capacity - 1;
+    int64_t s = pm_slot(key, mask);
+    while (pm->keys[s] != 0) {
+        if (pm->keys[s] == key) {
+            *parent = pm->parents[s];
+            return 1;
+        }
+        s = (s + 1) & mask;
+    }
+    return 0;
+}
+
+// Walk the parent chain from fp64 back to a zero parent (init marker).
+// Writes up to max_len fingerprints (discovery first, init last) into out.
+// Returns the chain length, -1 if a fingerprint is missing from the table
+// (host/device codec drift), or -2 if the chain exceeds max_len (cycle in
+// the parent pointers, which cannot happen for insert-once tables).
+int64_t parentmap_chain(const ParentMap* pm, uint64_t fp64, uint64_t* out,
+                        int64_t max_len) {
+    int64_t len = 0;
+    uint64_t cur = fp64;
+    while (cur != 0) {
+        if (len >= max_len) return -2;
+        uint64_t parent;
+        if (!parentmap_get(pm, cur, &parent)) return -1;
+        out[len++] = cur;
+        cur = parent;
+    }
+    return len;
+}
+
+}  // extern "C"

@@ -427,31 +427,32 @@ class XlaChecker(Checker):
             for name, fp64 in self._found_names.items()
         }
 
-    def _parent_map(self) -> Dict[int, int]:
-        """Pulls the device table once and builds fp64 -> parent fp64."""
-        kh = np.asarray(self._table.key_hi, dtype=np.uint64)
-        kl = np.asarray(self._table.key_lo, dtype=np.uint64)
-        vh = np.asarray(self._table.val_hi, dtype=np.uint64)
-        vl = np.asarray(self._table.val_lo, dtype=np.uint64)
-        occ = (kh != 0) | (kl != 0)
-        keys = (kh[occ] << np.uint64(32)) | kl[occ]
-        vals = (vh[occ] << np.uint64(32)) | vl[occ]
-        return {int(k): int(v) for k, v in zip(keys, vals)}
+    def _parent_map(self):
+        """Pulls the device table once and indexes fp64 -> parent fp64
+        (C++ open-addressing index when the native toolchain is present —
+        building a Python dict over millions of slots is the host hot spot
+        of witness reconstruction; see stateright_tpu/native)."""
+        from .native import ParentMap
 
-    def _path_for(self, fp64: int, parents: Dict[int, int]) -> Path:
+        return ParentMap(
+            np.asarray(self._table.key_hi),
+            np.asarray(self._table.key_lo),
+            np.asarray(self._table.val_hi),
+            np.asarray(self._table.val_lo),
+        )
+
+    def _path_for(self, fp64: int, parents) -> Path:
         """Walks parent fingerprints back to an init state, then re-executes
         the object model forward (bfs.rs:430-459 + path.rs:20-97, with the
-        packed fingerprint as the digest)."""
-        chain: List[int] = []
-        cur = fp64
-        while cur != 0:
-            chain.append(cur)
-            if cur not in parents:
-                raise RuntimeError(
-                    f"fingerprint {cur:#x} missing from the visited table during "
-                    "path reconstruction; packed model host/device codecs disagree"
-                )
-            cur = parents[cur]
+        packed fingerprint as the digest). ``parents`` is a
+        ``native.ParentMap``; the whole walk is one native call."""
+        try:
+            chain: List[int] = parents.chain(fp64)
+        except KeyError as e:
+            raise RuntimeError(
+                f"{e.args[0]} during path reconstruction; packed model "
+                "host/device codecs disagree"
+            ) from None
         chain.reverse()
 
         model = self._model
