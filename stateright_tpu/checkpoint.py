@@ -1,0 +1,138 @@
+"""Checkpoint/resume for the XLA checkers.
+
+The reference has no checkpointing — a run is memory-resident and
+single-shot (SURVEY.md §5). With the visited set resident in device HBM,
+host-side checkpointing becomes an explicit feature of this framework: long
+checks (or preemptible TPU time) can stop after any super-step and resume
+later, on a different chip count.
+
+Format (``np.savez_compressed``): the *logical* search state, independent of
+any engine's memory layout —
+
+- the visited set as compacted ``(fingerprint, parent)`` pairs (four uint32
+  lanes),
+- the frontier as packed state rows + eventually-bit words,
+- scalar progress counters and discovery pins,
+- model identity metadata (class name + packed geometry), validated on
+  restore.
+
+Restoring *rebuilds* the hash table by insertion, so a checkpoint written by
+the single-chip engine loads into the sharded engine (and vice versa), and
+capacities may differ across save/restore.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _normalize(path: str) -> str:
+    """np.savez appends '.npz' when absent; normalize both ends so any path
+    round-trips."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_checkpoint(checker, path: str) -> None:
+    """Writes the checker's logical search state. Valid after any number of
+    ``_run_block`` calls (between super-steps the device state is quiescent).
+    """
+    table = checker._table
+    kh = np.asarray(table.key_hi)
+    kl = np.asarray(table.key_lo)
+    vh = np.asarray(table.val_hi)
+    vl = np.asarray(table.val_lo)
+    occ = (kh != 0) | (kl != 0)
+
+    frontier_rows, frontier_ebits = _live_frontier(checker)
+
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "model": type(checker._model).__name__,
+        "state_words": checker._W,
+        "max_actions": checker._A,
+        "property_names": checker._prop_names,
+        "depth": checker._depth,
+        "max_depth": checker._max_depth,
+        "state_count": checker._state_count,
+        "unique_count": checker._unique_count,
+        "found_names": {k: int(v) for k, v in checker._found_names.items()},
+        "exhausted": checker._exhausted,
+        "target_reached": checker._target_reached,
+    }
+    np.savez_compressed(
+        _normalize(path),
+        meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        key_hi=kh[occ],
+        key_lo=kl[occ],
+        val_hi=vh[occ],
+        val_lo=vl[occ],
+        frontier=frontier_rows,
+        frontier_ebits=frontier_ebits,
+    )
+
+
+def _live_frontier(checker):
+    """The valid frontier rows + ebits, engine-layout-agnostic."""
+    from .parallel.sharded import ShardedXlaChecker
+
+    if isinstance(checker, ShardedXlaChecker):
+        D, Fl, W = checker._D, checker._Fl, checker._W
+        rows = np.asarray(checker._frontier).reshape(D, Fl, W)
+        ebits = np.asarray(checker._frontier_ebits).reshape(D, Fl)
+        counts = np.asarray(checker._counts)
+        live_rows = [rows[d, : counts[d]] for d in range(D)]
+        live_ebits = [ebits[d, : counts[d]] for d in range(D)]
+        return (
+            np.concatenate(live_rows) if live_rows else rows[:0, 0],
+            np.concatenate(live_ebits) if live_ebits else ebits[:0, 0],
+        )
+    n = checker._frontier_count
+    return (
+        np.asarray(checker._frontier)[:n],
+        np.asarray(checker._frontier_ebits)[:n],
+    )
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Reads a checkpoint into plain host arrays + metadata."""
+    with np.load(_normalize(path)) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta.get("format_version") != FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint format {meta.get('format_version')}"
+            )
+        return {
+            "meta": meta,
+            "key_hi": z["key_hi"],
+            "key_lo": z["key_lo"],
+            "val_hi": z["val_hi"],
+            "val_lo": z["val_lo"],
+            "frontier": z["frontier"],
+            "frontier_ebits": z["frontier_ebits"],
+        }
+
+
+def validate_model(meta: Dict[str, Any], model, prop_names) -> None:
+    """A checkpoint is only loadable into the model that wrote it."""
+    problems = []
+    if meta["model"] != type(model).__name__:
+        problems.append(f"model {meta['model']!r} != {type(model).__name__!r}")
+    if meta["state_words"] != model.state_words:
+        problems.append(
+            f"state_words {meta['state_words']} != {model.state_words}"
+        )
+    if meta["max_actions"] != model.max_actions:
+        problems.append(f"max_actions {meta['max_actions']} != {model.max_actions}")
+    if meta["property_names"] != list(prop_names):
+        problems.append(
+            f"properties {meta['property_names']} != {list(prop_names)}"
+        )
+    if problems:
+        raise ValueError(
+            "checkpoint does not match this model: " + "; ".join(problems)
+        )

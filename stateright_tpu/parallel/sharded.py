@@ -72,6 +72,7 @@ class ShardedXlaChecker(Checker):
         table_capacity: int = 1 << 20,
         route_capacity: Optional[int] = None,
         max_probes: int = 32,
+        checkpoint: Optional[str] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -123,6 +124,15 @@ class ShardedXlaChecker(Checker):
         self._plane_sharding = NamedSharding(mesh, self._plane_spec)
         self._rep_sharding = NamedSharding(mesh, P())
 
+        self._found_names: Dict[str, int] = {}
+        self._target_reached = False
+        self._step_cache: Dict[Any, Any] = {}
+
+        if checkpoint is not None:
+            # Skip init seeding entirely; _restore builds the whole state.
+            self._restore(checkpoint)
+            return
+
         # --- initial device state ----------------------------------------
         init_packed = np.asarray(model.packed_init(), dtype=np.uint32)
         keep = [model.within_boundary(model.unpack(row)) for row in init_packed]
@@ -130,28 +140,12 @@ class ShardedXlaChecker(Checker):
         n_init = len(init_packed)
 
         # Route init states to their owner shard host-side.
-        dedup_init = self._dedup_words_host(init_packed)
-        ihi, ilo = fphash.fingerprint_words(dedup_init, np)
-        owners = _owner_bits(ihi, ilo, D, np)
-        frontier = np.zeros((D, self._Fl, self._W), dtype=np.uint32)
-        fhi = np.zeros((D, self._Fl), dtype=np.uint32)
-        flo = np.zeros((D, self._Fl), dtype=np.uint32)
-        counts = np.zeros((D,), dtype=np.int32)
-        for row, hi, lo, owner in zip(init_packed, ihi, ilo, owners):
-            i = counts[owner]
-            if i >= self._Fl:
-                raise ValueError("frontier_capacity too small for init states")
-            frontier[owner, i] = row
-            fhi[owner, i] = hi
-            flo[owner, i] = lo
-            counts[owner] += 1
-
+        frontier, fhi, flo, ebits, counts = self._route_frontier_host(
+            init_packed, np.full(n_init, self._ebits0, dtype=np.uint32)
+        )
         self._frontier = jax.device_put(
             frontier.reshape(D * self._Fl, self._W), self._row_sharding
         )
-        ebits = np.zeros((D, self._Fl), dtype=np.uint32)
-        for d in range(D):
-            ebits[d, : counts[d]] = self._ebits0
         self._frontier_ebits = jax.device_put(
             ebits.reshape(D * self._Fl), self._plane_sharding
         )
@@ -162,14 +156,8 @@ class ShardedXlaChecker(Checker):
             *(jax.device_put(p, self._plane_sharding) for p in table)
         )
         # Insert init fingerprints (shard-local batches, zero parents).
-        ins = self._sharded_init_insert()
-        planes, n_unique_init = ins(
-            tuple(self._table),
-            jax.device_put(fhi.reshape(-1), self._plane_sharding),
-            jax.device_put(flo.reshape(-1), self._plane_sharding),
-            self._counts,
-        )
-        self._table = hashset.HashSet(*planes)
+        zeros = np.zeros_like(fhi)
+        n_unique_init = self._bulk_insert(fhi, flo, zeros, zeros, counts)
         self._disc_found = jax.device_put(
             jnp.zeros(self._P, jnp.bool_), self._rep_sharding
         )
@@ -181,10 +169,84 @@ class ShardedXlaChecker(Checker):
         self._max_depth = 0
         self._state_count = n_init
         self._unique_count = int(n_unique_init)
-        self._found_names: Dict[str, int] = {}
         self._exhausted = n_init == 0
-        self._target_reached = False
-        self._step_cache: Dict[Any, Any] = {}
+
+    # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        from ..checkpoint import save_checkpoint
+
+        save_checkpoint(self, path)
+
+    def _restore(self, path: str) -> None:
+        """Loads a checkpoint, re-routing frontier rows and table entries to
+        their owner shards — the checkpoint is layout-agnostic, so one
+        written by the single-chip engine (or a different mesh size) loads
+        here."""
+        import jax
+
+        from ..checkpoint import load_checkpoint, validate_model
+
+        ck = load_checkpoint(path)
+        validate_model(ck["meta"], self._model, self._prop_names)
+        D = self._D
+
+        # Visited set: distribute entries by owner, then bulk-insert.
+        kh = np.asarray(ck["key_hi"], dtype=np.uint32)
+        kl = np.asarray(ck["key_lo"], dtype=np.uint32)
+        vh = np.asarray(ck["val_hi"], dtype=np.uint32)
+        vl = np.asarray(ck["val_lo"], dtype=np.uint32)
+        owners = _owner_bits(kh, kl, D, np)
+        counts, order, pos = self._shard_positions(owners, D)
+        B = max(16, int(counts.max()))
+        while self._Cl < 2 * B:
+            self._Cl *= 2
+        import jax.numpy as jnp
+
+        table = hashset.make(D * self._Cl, jnp)
+        self._table = hashset.HashSet(
+            *(jax.device_put(p, self._plane_sharding) for p in table)
+        )
+        blocks = [np.zeros((D, B), dtype=np.uint32) for _ in range(4)]
+        shard = owners[order]
+        for block, lane in zip(blocks, (kh, kl, vh, vl)):
+            block[shard, pos] = lane[order]
+        self._bulk_insert(*blocks, counts)
+
+        # Frontier: re-route rows to their owners.
+        rows = np.asarray(ck["frontier"], dtype=np.uint32)
+        frontier, _fhi, _flo, ebits, fcounts = self._route_frontier_host(
+            rows, np.asarray(ck["frontier_ebits"], dtype=np.uint32)
+        )
+        Fl = self._Fl
+        self._frontier = jax.device_put(
+            frontier.reshape(D * Fl, self._W), self._row_sharding
+        )
+        self._frontier_ebits = jax.device_put(
+            ebits.reshape(D * Fl), self._plane_sharding
+        )
+        self._counts = jax.device_put(fcounts, self._plane_sharding)
+
+        meta = ck["meta"]
+        self._depth = meta["depth"]
+        self._max_depth = meta["max_depth"]
+        self._state_count = meta["state_count"]
+        self._unique_count = meta["unique_count"]
+        self._found_names = dict(meta["found_names"])
+        self._exhausted = meta["exhausted"]
+        self._target_reached = meta["target_reached"]
+        disc_found = np.zeros(self._P, dtype=bool)
+        disc_fp = np.zeros((self._P, 2), dtype=np.uint32)
+        for i, name in enumerate(self._prop_names):
+            if name in self._found_names:
+                fp64 = self._found_names[name]
+                disc_found[i] = True
+                disc_fp[i, 0] = fp64 >> 32
+                disc_fp[i, 1] = fp64 & 0xFFFFFFFF
+        self._disc_found = jax.device_put(
+            jnp.asarray(disc_found), self._rep_sharding
+        )
+        self._disc_fp = jax.device_put(jnp.asarray(disc_fp), self._rep_sharding)
 
     # --- host helpers (shared semantics with the single-chip engine) ------
 
@@ -218,32 +280,112 @@ class ShardedXlaChecker(Checker):
             )
         return jax.jit(smap)
 
-    def _sharded_init_insert(self):
+    @staticmethod
+    def _shard_positions(owners: np.ndarray, D: int):
+        """Vectorized bucket placement: for each element, its shard and its
+        position within that shard (stable order). Returns
+        ``(counts[D], sorted_order, pos_in_shard)``."""
+        counts = np.bincount(owners, minlength=D).astype(np.int32)
+        order = np.argsort(owners, kind="stable")
+        offsets = np.zeros(D, dtype=np.int64)
+        np.cumsum(counts[:-1], out=offsets[1:])
+        pos = np.arange(len(owners), dtype=np.int64) - np.repeat(offsets, counts)
+        return counts, order, pos
+
+    def _route_frontier_host(self, rows: np.ndarray, ebits_values: np.ndarray):
+        """Distribute packed rows to their owner shard (host-side,
+        vectorized; used for init seeding and checkpoint restore). Grows
+        ``Fl`` (and rescales the routing capacity) to fit. Returns
+        ``(frontier[D,Fl,W], fhi[D,Fl], flo[D,Fl], ebits[D,Fl], counts[D])``.
+        """
+        D, W = self._D, self._W
+        n = len(rows)
+        if n:
+            dedup = self._dedup_words_host(rows)
+            ihi, ilo = fphash.fingerprint_words(dedup, np)
+            owners = _owner_bits(ihi, ilo, D, np)
+            counts, order, pos = self._shard_positions(owners, D)
+            grew = False
+            while self._Fl < int(counts.max()):
+                self._Fl *= 2
+                grew = True
+            if grew:
+                # Keep the routing buffers scaled with the frontier, as
+                # _grow_frontier does — otherwise the first superstep would
+                # churn through route-overflow recompiles.
+                local_cand = self._Fl * self._A
+                self._K = min(local_cand, max(self._K, (local_cand // D) * 4))
+        Fl = self._Fl
+        frontier = np.zeros((D, Fl, W), dtype=np.uint32)
+        fhi = np.zeros((D, Fl), dtype=np.uint32)
+        flo = np.zeros((D, Fl), dtype=np.uint32)
+        ebits = np.zeros((D, Fl), dtype=np.uint32)
+        out_counts = np.zeros((D,), dtype=np.int32)
+        if n:
+            shard = owners[order]
+            frontier[shard, pos] = rows[order]
+            fhi[shard, pos] = ihi[order]
+            flo[shard, pos] = ilo[order]
+            ebits[shard, pos] = np.asarray(ebits_values, dtype=np.uint32)[order]
+            out_counts = counts
+        return frontier, fhi, flo, ebits, out_counts
+
+    def _bulk_insert(
+        self,
+        fhi: np.ndarray,
+        flo: np.ndarray,
+        vhi: np.ndarray,
+        vlo: np.ndarray,
+        counts: np.ndarray,
+    ) -> int:
+        """Insert per-shard blocks ``[D, B]`` of (fingerprint, value) pairs
+        into the sharded table; grows the table and retries on overflow.
+        Returns the number of new entries (psum over shards)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
-        Fl, max_probes = self._Fl, self._max_probes
+        D, B = fhi.shape
+        max_probes = self._max_probes
 
-        def body(table, fhi, flo, count):
-            active = jnp.arange(Fl) < count[0]
-            table, is_new, ovf = hashset.insert(
-                hashset.HashSet(*table),
-                fhi,
-                flo,
-                jnp.zeros(Fl, jnp.uint32),
-                jnp.zeros(Fl, jnp.uint32),
-                active,
-                max_probes=max_probes,
+        def build():
+            def body(table, fh, fl, vh, vl, count):
+                active = jnp.arange(B) < count[0]
+                table, is_new, ovf = hashset.insert(
+                    hashset.HashSet(*table), fh, fl, vh, vl, active,
+                    max_probes=max_probes,
+                )
+                unique = jax.lax.psum(jnp.sum(is_new, dtype=jnp.int32), "shards")
+                any_ovf = jax.lax.pmax(jnp.any(ovf).astype(jnp.uint32), "shards")
+                return tuple(table), unique, any_ovf
+
+            return self._shard_map(
+                body,
+                in_specs=(
+                    (P("shards"),) * 4,
+                    P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),
+                ),
+                out_specs=((P("shards"),) * 4, P(), P()),
             )
-            unique = jax.lax.psum(jnp.sum(is_new, dtype=jnp.int32), "shards")
-            return tuple(table), unique
 
-        return self._shard_map(
-            body,
-            in_specs=((P("shards"),) * 4, P("shards"), P("shards"), P("shards")),
-            out_specs=((P("shards"),) * 4, P()),
-        )
+        cache = self.__dict__.setdefault("_bulk_insert_cache", {})
+        put = lambda a: jax.device_put(a.reshape(-1), self._plane_sharding)
+        while True:
+            # Re-key per attempt: _grow_table changes the plane shapes.
+            key = ("bulk", B, self._Cl)
+            fn = cache.get(key)
+            if fn is None:
+                fn = cache[key] = build()
+            planes, unique, ovf = fn(
+                tuple(self._table),
+                put(fhi), put(flo), put(vhi), put(vlo),
+                jax.device_put(counts, self._plane_sharding),
+            )
+            if bool(np.asarray(ovf)):
+                self._grow_table()
+                continue
+            self._table = hashset.HashSet(*planes)
+            return int(np.asarray(unique))
 
     def _build_superstep(self, Fl: int, Cl: int, K: int):
         import jax

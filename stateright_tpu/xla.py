@@ -85,6 +85,7 @@ class XlaChecker(Checker):
         frontier_capacity: int = 1 << 15,
         table_capacity: int = 1 << 20,
         max_probes: int = 32,
+        checkpoint: Optional[str] = None,
     ):
         import jax
 
@@ -118,6 +119,19 @@ class XlaChecker(Checker):
 
         # --- device state ------------------------------------------------
         import jax.numpy as jnp
+
+        self._disc_found = jnp.zeros(self._P, jnp.bool_)
+        self._disc_fp = jnp.zeros((self._P, 2), jnp.uint32)
+        self._found_names: Dict[str, int] = {}  # name -> fp64, pinned on first find
+        self._target_reached = False
+        self._superstep_cache: Dict[int, Any] = {}
+
+        if checkpoint is not None:
+            # Skip init seeding entirely; _restore builds the whole state.
+            self._frontier_capacity = max(frontier_capacity, 16)
+            self._table = hashset.make(table_capacity, jnp)
+            self._restore(checkpoint)
+            return
 
         init_packed = np.asarray(model.packed_init(), dtype=np.uint32)
         # Boundary filter on init states (bfs.rs:52-56) is the model's
@@ -159,12 +173,74 @@ class XlaChecker(Checker):
         self._max_depth = 0
         self._state_count = n_init
         self._unique_count = n_unique_init
-        self._disc_found = jnp.zeros(self._P, jnp.bool_)
-        self._disc_fp = jnp.zeros((self._P, 2), jnp.uint32)
-        self._found_names: Dict[str, int] = {}  # name -> fp64, pinned on first find
         self._exhausted = n_init == 0
-        self._target_reached = False
-        self._superstep_cache: Dict[int, Any] = {}
+
+    # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        from .checkpoint import save_checkpoint
+
+        save_checkpoint(self, path)
+
+    def _restore(self, path: str) -> None:
+        """Replaces the freshly-initialized search state with a checkpoint's
+        (the table is rebuilt by insertion, so capacities may differ)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .checkpoint import load_checkpoint, validate_model
+
+        ck = load_checkpoint(path)
+        validate_model(ck["meta"], self._model, self._prop_names)
+
+        n_entries = len(ck["key_hi"])
+        cap = self._table.capacity
+        while cap < 2 * n_entries:
+            cap *= 2
+        self._table = hashset.make(cap, jnp)
+        while True:
+            table, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
+                self._table,
+                jnp.asarray(ck["key_hi"]),
+                jnp.asarray(ck["key_lo"]),
+                jnp.asarray(ck["val_hi"]),
+                jnp.asarray(ck["val_lo"]),
+                jnp.ones(n_entries, jnp.bool_),
+                max_probes=self._max_probes,
+            )
+            if not bool(np.any(np.asarray(ovf))):
+                self._table = table
+                break
+            self._table = hashset.make(self._table.capacity * 2, jnp)
+
+        rows = np.asarray(ck["frontier"], dtype=np.uint32)
+        n = len(rows)
+        while self._frontier_capacity < n:
+            self._frontier_capacity *= 2
+        self._frontier = self._pad_rows(rows, self._frontier_capacity)
+        ebits = np.zeros(self._frontier_capacity, dtype=np.uint32)
+        ebits[:n] = np.asarray(ck["frontier_ebits"], dtype=np.uint32)
+        self._frontier_ebits = jnp.asarray(ebits)
+        self._frontier_count = n
+
+        meta = ck["meta"]
+        self._depth = meta["depth"]
+        self._max_depth = meta["max_depth"]
+        self._state_count = meta["state_count"]
+        self._unique_count = meta["unique_count"]
+        self._found_names = dict(meta["found_names"])
+        self._exhausted = meta["exhausted"]
+        self._target_reached = meta["target_reached"]
+        disc_found = np.zeros(self._P, dtype=bool)
+        disc_fp = np.zeros((self._P, 2), dtype=np.uint32)
+        for i, name in enumerate(self._prop_names):
+            if name in self._found_names:
+                fp64 = self._found_names[name]
+                disc_found[i] = True
+                disc_fp[i, 0] = fp64 >> 32
+                disc_fp[i, 1] = fp64 & 0xFFFFFFFF
+        self._disc_found = jnp.asarray(disc_found)
+        self._disc_fp = jnp.asarray(disc_fp)
 
     # --- helpers ----------------------------------------------------------
 

@@ -1,0 +1,124 @@
+"""Checkpoint/resume tests: stop after any super-step, resume later, on a
+different engine/mesh — counts and discoveries must come out identical to an
+uninterrupted run."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+from stateright_tpu.parallel import default_mesh
+
+
+def _full_run_reference():
+    checker = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    ).join()
+    return checker
+
+
+def test_single_chip_save_resume_roundtrip(tmp_path):
+    ref = _full_run_reference()
+    path = str(tmp_path / "ck.npz")
+
+    partial = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+    for _ in range(4):  # part-way through the 14-level space
+        partial._run_block()
+    partial.save_checkpoint(path)
+
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=path
+    )
+    assert resumed.state_count() == partial.state_count()
+    assert resumed.unique_state_count() == partial.unique_state_count()
+    resumed.join()
+    assert resumed.unique_state_count() == ref.unique_state_count() == 1_568
+    assert resumed.state_count() == ref.state_count()
+    assert resumed.max_depth() == ref.max_depth()
+    assert set(resumed.discoveries()) == set(ref.discoveries())
+    resumed.assert_properties()
+
+
+def test_resume_with_different_capacities(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    partial = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+    for _ in range(4):
+        partial._run_block()
+    partial.save_checkpoint(path)
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 5, table_capacity=1 << 6, checkpoint=path
+    ).join()
+    assert resumed.unique_state_count() == 1_568
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device mesh")
+def test_cross_engine_single_chip_to_sharded(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    partial = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+    for _ in range(5):
+        partial._run_block()
+    partial.save_checkpoint(path)
+
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        mesh=default_mesh(8),
+        frontier_capacity=1 << 10,
+        table_capacity=1 << 13,
+        checkpoint=path,
+    )
+    assert resumed.unique_state_count() == partial.unique_state_count()
+    resumed.join()
+    assert resumed.unique_state_count() == 1_568
+    resumed.assert_properties()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device mesh")
+def test_cross_engine_sharded_to_single_chip(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    partial = PackedTwoPhaseSys(4).checker().spawn_xla(
+        mesh=default_mesh(8), frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+    for _ in range(5):
+        partial._run_block()
+    partial.save_checkpoint(path)
+
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=path
+    ).join()
+    assert resumed.unique_state_count() == 1_568
+    resumed.assert_properties()
+
+
+def test_checkpoint_rejects_wrong_model(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    ).save_checkpoint(path)
+    with pytest.raises(ValueError, match="does not match"):
+        PackedTwoPhaseSys(5).checker().spawn_xla(
+            frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=path
+        )
+
+
+def test_checkpoint_preserves_discovery_pins(tmp_path):
+    # Run to completion (both sometimes-properties found), checkpoint, and
+    # resume: the resumed checker must report the same witnesses without
+    # re-searching.
+    path = str(tmp_path / "ck.npz")
+    done = PackedTwoPhaseSys(3).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    ).join()
+    done.save_checkpoint(path)
+    resumed = PackedTwoPhaseSys(3).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=path
+    )
+    assert resumed._found_names == done._found_names
+    a = {n: p.into_actions() for n, p in done.discoveries().items()}
+    b = {n: p.into_actions() for n, p in resumed.discoveries().items()}
+    assert a == b
