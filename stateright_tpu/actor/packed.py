@@ -1,0 +1,213 @@
+"""Packed actor models: the actor framework on the device engine.
+
+The reference's strategy boundary means ``ActorModel`` runs on any checker
+because it implements ``Model`` (model.rs:200). On the device engine the
+extra requirement is the :class:`~stateright_tpu.xla.XlaChecker` PackedModel
+protocol: a fixed-width bit-packed transition kernel. This module provides
+
+- the packing pattern for actor systems: per-actor state fields + the
+  modeled network as a **bitmask over a closed envelope universe** (for
+  unordered-duplicating semantics a set-of-envelopes IS a bitmask; bounded
+  multisets/FIFOs use small counters per universe slot), and
+- :class:`PackedPingPong`, the canonical fixture (actor_test_util.rs:4-126)
+  in packed form, differentially tested against the object ``ActorModel``
+  (exact 4,094-state parity on the lossy max=5 configuration,
+  model.rs:680).
+
+The wrapper *delegates* the object-level ``Model`` API to the underlying
+``ActorModel``, so path reconstruction, the Explorer, and property lambdas
+see ordinary actor states; only the engine-facing ``packed_*`` kernels are
+hand-packed. This is the M3 milestone pattern (SURVEY.md §7): pack the
+state, keep the semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from ..core import Model
+from .actor_test_util import Ping, PingPongCfg, Pong, ping_pong_model
+from .model_state import ActorModelState
+from .network import Envelope, UnorderedDuplicatingNetwork
+from .timers import Timers
+from . import Id
+
+# word 0 layout: actor counts + history counters.
+_C0_SHIFT, _C1_SHIFT, _IN_SHIFT, _OUT_SHIFT = 0, 4, 8, 16
+_C_MASK, _H_MASK = 0xF, 0xFF
+# word 1 layout: Ping(v) presence at bit v, Pong(v) presence at bit 16+v.
+_PONG_SHIFT = 16
+
+
+class PackedPingPong(Model):
+    """The ping-pong ``ActorModel`` with a two-word packed codec.
+
+    Supports the unordered-duplicating network (the ``ActorModel`` default),
+    lossy or lossless, with or without history. ``max_nat`` must fit the
+    4-bit count fields (<= 14) and the 16 envelope-value slots (<= 14).
+    """
+
+    state_words = 2
+
+    def __init__(self, cfg: PingPongCfg, lossy: bool = False):
+        if cfg.max_nat > 14:
+            raise ValueError("max_nat > 14 exceeds the packed field widths")
+        self.cfg = cfg
+        self.lossy = lossy
+        inner = ping_pong_model(cfg)
+        if lossy:
+            inner = inner.lossy_network(True)
+        self._inner = inner
+        # Envelope-value universe: Ping(v)/Pong(v) for v in 0..max_nat
+        # (boundary caps actor counts at max_nat, so no larger value is
+        # ever sent; see the step kernel's boundary mask).
+        self._V = cfg.max_nat + 1
+        # Action grid: deliver each universe envelope (+ drop it if lossy).
+        self.max_actions = (2 if lossy else 1) * 2 * self._V
+
+    # --- object-level Model API: delegate to the ActorModel ----------------
+
+    def init_states(self) -> List[ActorModelState]:
+        return self._inner.init_states()
+
+    def actions(self, state, actions: List[Any]) -> None:
+        self._inner.actions(state, actions)
+
+    def next_state(self, state, action):
+        return self._inner.next_state(state, action)
+
+    def properties(self):
+        return self._inner.properties()
+
+    def within_boundary(self, state) -> bool:
+        return self._inner.within_boundary(state)
+
+    def format_action(self, action) -> str:
+        return self._inner.format_action(action)
+
+    # --- codec -------------------------------------------------------------
+
+    def pack(self, state: ActorModelState) -> np.ndarray:
+        c0, c1 = state.actor_states
+        hist_in, hist_out = state.history if state.history else (0, 0)
+        w0 = (
+            (c0 & _C_MASK)
+            | ((c1 & _C_MASK) << _C1_SHIFT)
+            | ((hist_in & _H_MASK) << _IN_SHIFT)
+            | ((hist_out & _H_MASK) << _OUT_SHIFT)
+        )
+        w1 = 0
+        for env in state.network.envelopes:
+            if isinstance(env.msg, Ping):
+                w1 |= 1 << env.msg.value
+            else:
+                w1 |= 1 << (_PONG_SHIFT + env.msg.value)
+        return np.asarray([w0, w1], dtype=np.uint32)
+
+    def unpack(self, words) -> ActorModelState:
+        w0, w1 = (int(w) for w in words)
+        envs = []
+        for v in range(self._V):
+            if (w1 >> v) & 1:
+                envs.append(Envelope(Id(0), Id(1), Ping(v)))
+            if (w1 >> (_PONG_SHIFT + v)) & 1:
+                envs.append(Envelope(Id(1), Id(0), Pong(v)))
+        return ActorModelState(
+            actor_states=(w0 & _C_MASK, (w0 >> _C1_SHIFT) & _C_MASK),
+            network=UnorderedDuplicatingNetwork(frozenset(envs)),
+            timers_set=(Timers(), Timers()),
+            history=(
+                ((w0 >> _IN_SHIFT) & _H_MASK, (w0 >> _OUT_SHIFT) & _H_MASK)
+                if self.cfg.maintains_history
+                else (0, 0)
+            ),
+        )
+
+    # --- device kernels -----------------------------------------------------
+
+    def packed_init(self) -> np.ndarray:
+        return np.stack([self.pack(s) for s in self._inner.init_states()])
+
+    def packed_step(self, words):
+        """Full action fan-out of one packed state: deliver every universe
+        envelope (no-op deliveries and boundary violations masked invalid,
+        the packed collapse of model.rs:286-289 and within_boundary), plus
+        a drop per envelope when lossy."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        w0, w1 = words[0], words[1]
+        c0 = w0 & u(_C_MASK)
+        c1 = (w0 >> u(_C1_SHIFT)) & u(_C_MASK)
+        max_nat = u(self.cfg.max_nat)
+        hist_bump = (
+            u((1 << _IN_SHIFT) | (1 << _OUT_SHIFT))
+            if self.cfg.maintains_history
+            else u(0)
+        )
+
+        nxt, valid = [], []
+        for v in range(self._V):
+            uv = u(v)
+            # Deliver Ping(v) to actor 1 (actor_test_util.rs on_msg): bump
+            # its count, reply Pong(v). Dup network: the Ping bit stays.
+            present = ((w1 >> uv) & u(1)) != 0
+            effective = present & (c1 == uv)
+            ok = effective & (c1 + u(1) <= max_nat)
+            n_w0 = w0 + (u(1) << u(_C1_SHIFT)) + hist_bump
+            n_w1 = w1 | (u(1) << (uv + u(_PONG_SHIFT)))
+            nxt.append(jnp.stack([n_w0, n_w1]))
+            valid.append(ok)
+            # Deliver Pong(v) to actor 0: bump its count, send Ping(v+1).
+            present = ((w1 >> (uv + u(_PONG_SHIFT))) & u(1)) != 0
+            effective = present & (c0 == uv)
+            ok = effective & (c0 + u(1) <= max_nat)
+            n_w0 = w0 + u(1) + hist_bump
+            n_w1 = w1 | (u(1) << (uv + u(1)))
+            nxt.append(jnp.stack([n_w0, n_w1]))
+            valid.append(ok)
+        if self.lossy:
+            for v in range(self._V):
+                for bit in (v, _PONG_SHIFT + v):
+                    present = ((w1 >> u(bit)) & u(1)) != 0
+                    n_w1 = w1 & ~(u(1) << u(bit))
+                    nxt.append(jnp.stack([w0, n_w1]))
+                    valid.append(present)
+        return jnp.stack(nxt), jnp.stack(valid)
+
+    def packed_properties(self, words):
+        """The fixture's six properties (actor_test_util.rs:68-124), in
+        ``properties()`` order."""
+        import jax.numpy as jnp
+
+        u = jnp.uint32
+        w0 = words[0]
+        c0 = w0 & u(_C_MASK)
+        c1 = (w0 >> u(_C1_SHIFT)) & u(_C_MASK)
+        hist_in = (w0 >> u(_IN_SHIFT)) & u(_H_MASK)
+        hist_out = (w0 >> u(_OUT_SHIFT)) & u(_H_MASK)
+        max_nat = u(self.cfg.max_nat)
+        delta_ok = jnp.where(c0 > c1, c0 - c1, c1 - c0) <= u(1)
+        at_max = (c0 == max_nat) | (c1 == max_nat)
+        over_max = (c0 == max_nat + u(1)) | (c1 == max_nat + u(1))
+        return jnp.stack(
+            [
+                delta_ok,  # always "delta within 1"
+                at_max,  # sometimes "can reach max"
+                at_max,  # eventually "must reach max"
+                over_max,  # eventually "must exceed max" (falsifiable)
+                hist_in <= hist_out,  # always "#in <= #out"
+                hist_out <= hist_in + u(1),  # eventually "#out <= #in + 1"
+            ]
+        )
+
+    def __getattr__(self, name):
+        # Property lambdas receive this wrapper as `model`; expose the
+        # ActorModel's attributes (cfg is set explicitly above). Private
+        # names never delegate — unguarded delegation would recurse when
+        # __dict__ is empty (e.g. during unpickling).
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
