@@ -7,12 +7,22 @@ The metric is generated-states per second (the reference's own notion of
 throughput: ``state_count / sec`` from its reporter output, report.rs:66-73)
 over a full-coverage check of 2pc with ``BENCH_RM`` resource managers
 (default 8 — large enough that steady-state frontiers keep the chip busy).
-Compilation is excluded (the first super-step triggers it; timing starts
-after).  ``vs_baseline`` is the ratio against the driver-defined north-star
-of 50M states/sec (BASELINE.md).
 
-Runs on the default JAX platform (the axon TPU under the driver); falls back
-to CPU if TPU init fails so the driver always gets a line.
+Methodology: the check runs TWICE. The first run compiles every superstep
+bucket the level schedule touches (compilations are cached in-process and
+in ``.jax_cache`` across processes); the second run is the measured,
+steady-state one. ``vs_baseline`` is the ratio against the driver-defined
+north-star of 50M states/sec (BASELINE.md).
+
+Runs on the default JAX platform (the axon TPU under the driver); falls
+back to CPU if the TPU tunnel doesn't come up inside ``BENCH_TPU_PROBE_S``
+(default 600) so the driver always gets a line. Probe diagnostics go to
+stderr and ``bench_probe.log`` — round-1's silent fallback is the bug this
+fixes (VERDICT.md weak #1).
+
+Per-level timing detail is written to ``bench_detail.json`` (levels,
+frontier widths, per-level seconds, compile vs steady split) for the
+BASELINE.md breakdown.
 """
 
 from __future__ import annotations
@@ -23,32 +33,92 @@ import sys
 import time
 
 NORTH_STAR = 50_000_000.0
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def _tpu_available(timeout_s: int = 120) -> bool:
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+    with open(os.path.join(REPO, "bench_probe.log"), "a") as fh:
+        fh.write(f"{time.strftime('%H:%M:%S')} {msg}\n")
+
+
+def _tpu_available(timeout_s: int) -> bool:
     """Probe TPU availability in a subprocess: the axon tunnel can HANG
     (not fail) for many minutes inside jax.devices(), which would eat the
-    whole bench budget. A killed probe counts as unavailable."""
+    whole bench budget. A killed probe counts as unavailable. The probe's
+    own stderr is logged, not swallowed."""
     import subprocess
 
+    code = (
+        "import jax; ds = jax.devices(); "
+        "print('ok', [str(d) for d in ds], ds[0].platform)"
+    )
+    t0 = time.monotonic()
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            [sys.executable, "-c", code],
             timeout=timeout_s,
             capture_output=True,
             text=True,
         )
-        return proc.returncode == 0 and "ok" in proc.stdout
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        _log(
+            f"TPU probe timed out after {timeout_s}s; stderr tail: "
+            f"{(e.stderr or b'')[-500:] if isinstance(e.stderr, bytes) else (e.stderr or '')[-500:]}"
+        )
         return False
+    _log(
+        f"TPU probe rc={proc.returncode} in {time.monotonic()-t0:.1f}s; "
+        f"stdout={proc.stdout.strip()[:200]!r} stderr tail={proc.stderr[-500:]!r}"
+    )
+    return proc.returncode == 0 and "ok" in proc.stdout
+
+
+def _run_check(model, frontier_pow: int, table_pow: int, detail: list | None):
+    """One full-coverage check; returns (generated_states, seconds, checker)."""
+    checker = model.checker().spawn_xla(
+        frontier_capacity=1 << frontier_pow,
+        table_capacity=1 << table_pow,
+    )
+    t0 = time.monotonic()
+    states0 = checker.state_count()
+    while not checker.is_done():
+        lvl_t0 = time.monotonic()
+        width = checker._frontier_count
+        checker._run_block()
+        if detail is not None:
+            detail.append(
+                {
+                    "depth": checker._depth - 1,
+                    "frontier": width,
+                    "sec": round(time.monotonic() - lvl_t0, 4),
+                }
+            )
+    elapsed = time.monotonic() - t0
+    checker.assert_properties()
+    return checker.state_count() - states0, elapsed, checker
 
 
 def main() -> None:
     rm = int(os.environ.get("BENCH_RM", "8"))
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    probe_s = int(os.environ.get("BENCH_TPU_PROBE_S", "600"))
+    sys.path.insert(0, REPO)
 
-    use_tpu = _tpu_available()
+    use_tpu = _tpu_available(probe_s)
     import jax
+
+    if use_tpu:
+        # Persistent compilation cache: supersteps recompile identically
+        # across rounds/processes; this turns the ~1 min/bucket TPU compile
+        # into a disk hit after the first round. (CPU loads are skipped:
+        # XLA:CPU AOT reload warns about machine-feature mismatches.)
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:  # pragma: no cover - older jax
+            _log(f"compilation cache unavailable: {e}")
 
     frontier_pow = int(os.environ.get("BENCH_FRONTIER_POW", "19"))
     table_pow = int(os.environ.get("BENCH_TABLE_POW", "24"))
@@ -58,27 +128,46 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
     if platform == "cpu":
-        rm = min(rm, 6)
-        # The insert's per-round claim buffer is O(table); TPU-sized tables
-        # drown a CPU run. The engine grows the table on demand anyway.
-        frontier_pow = min(frontier_pow, 14)
-        table_pow = min(table_pow, 17)
+        rm = min(rm, int(os.environ.get("BENCH_CPU_RM", "7")))
+        frontier_pow = min(frontier_pow, 17)
+        table_pow = min(table_pow, 21)
+    _log(f"platform={platform} rm={rm} frontier=2^{frontier_pow} table=2^{table_pow}")
 
     from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
 
-    checker = PackedTwoPhaseSys(rm).checker().spawn_xla(
-        frontier_capacity=1 << frontier_pow,
-        table_capacity=1 << table_pow,
-    )
-    # First block compiles; exclude it from timing but count its states.
-    checker._run_block()
-    t0 = time.monotonic()
-    states_before = checker.state_count()
-    checker.join()
-    elapsed = time.monotonic() - t0
-    states = checker.state_count() - states_before
+    # ONE model instance for both passes: compiled supersteps are cached on
+    # the model, so pass 2 reuses every bucket compilation from pass 1.
+    model = PackedTwoPhaseSys(rm)
+
+    # Pass 1: warm every superstep bucket (compile time, excluded).
+    warm_states, warm_sec, _ = _run_check(model, frontier_pow, table_pow, None)
+    _log(f"warm pass: {warm_states} states in {warm_sec:.2f}s (compile included)")
+
+    # Pass 2: measured steady-state run.
+    detail: list = []
+    states, elapsed, checker = _run_check(model, frontier_pow, table_pow, detail)
     value = states / max(elapsed, 1e-9)
-    checker.assert_properties()
+    _log(
+        f"measured pass: {states} states ({checker.unique_state_count()} unique, "
+        f"depth {checker.max_depth()}) in {elapsed:.2f}s -> {value:,.0f} states/s"
+    )
+
+    with open(os.path.join(REPO, "bench_detail.json"), "w") as fh:
+        json.dump(
+            {
+                "platform": platform,
+                "rm": rm,
+                "generated_states": states,
+                "unique_states": checker.unique_state_count(),
+                "max_depth": checker.max_depth(),
+                "warm_pass_sec": round(warm_sec, 3),
+                "measured_sec": round(elapsed, 3),
+                "states_per_sec": round(value, 1),
+                "levels": detail,
+            },
+            fh,
+            indent=1,
+        )
 
     print(
         json.dumps(

@@ -14,8 +14,13 @@ parent-pointer scheme as bfs.rs:351).  EMPTY is key == (0, 0);
 ``fphash.fingerprint_words`` never produces that pair.
 
 Everything is functional (donated/threaded through jit) and shape-static, so
-the whole super-step fuses into one XLA program; per-round cost is a few
-O(batch) gathers/scatters plus one O(capacity) claim-buffer fill.
+the whole super-step fuses into one XLA program.  Per-round cost is
+O(batch): the slot election scatters into a claim buffer of size
+``~2*batch`` indexed by ``slot mod B`` rather than a full ``[capacity]``
+plane — a false conflict (two different slots sharing a claim index) only
+delays the loser to the next round, so correctness and the min-index
+determinism are unaffected while insert bandwidth scales with the batch,
+not the table.
 """
 
 from __future__ import annotations
@@ -57,9 +62,14 @@ def insert(
     - ``is_new[i]``: the fingerprint was not present and this batch element
       won the slot (exactly one winner among in-batch duplicates; the winner
       is the lowest batch index, for determinism).
-    - ``overflow[i]``: still unresolved after ``max_probes`` linear-probe
-      rounds — the caller must grow/rehash (the reference leans on DashMap
-      resizing; here growth is an explicit host-driven rehash).
+    - ``overflow[i]``: still unresolved after ``max_probes`` genuine probe
+      advances (slots occupied by *other* keys) — the caller must
+      grow/rehash (the reference leans on DashMap resizing; here growth is
+      an explicit host-driven rehash). Election losses in the claim buffer
+      do NOT count against the budget: a loss means some other element
+      completed that round, so retries make global progress and growing
+      the table (which cannot change claim contention) is never the wrong
+      remedy for a reported overflow.
 
     Shape-static, jit-friendly; all elections are commutative scatter-mins,
     so results do not depend on scatter execution order.
@@ -72,24 +82,39 @@ def insert(
     m = fp_hi.shape[0]
     ticket = jnp.arange(m, dtype=jnp.int32)
     sentinel = jnp.int32(2**31 - 1)
+    # Claim buffer: a power of two >= 2*batch (capped at the table size),
+    # indexed by the low bits of the slot. Distinct slots sharing a claim
+    # index is a *false conflict*: the election loser keeps its slot and
+    # retries next round, so results stay exact — this is what makes insert
+    # bandwidth O(batch) instead of O(capacity).
+    claim_cap = 16
+    while claim_cap < 2 * m:
+        claim_cap *= 2
+    claim_cap = min(claim_cap, cap)
+    cmask = jnp.uint32(claim_cap - 1)
 
     slot0 = ((fp_hi ^ (fp_lo * jnp.uint32(0x9E3779B1))) & mask).astype(jnp.int32)
     done0 = ~active
     is_new0 = jnp.zeros((m,), dtype=jnp.bool_)
+    probes0 = jnp.zeros((m,), dtype=jnp.int32)
 
-    def round_fn(_, carry):
-        slot, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t = carry
+    def round_fn(carry):
+        rnd, slot, probes, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t = carry
+        live = ~done & (probes < max_probes)
         kh = key_hi[slot]
         kl = key_lo[slot]
         occupied = (kh != 0) | (kl != 0)
-        match = occupied & (kh == fp_hi) & (kl == fp_lo)
+        match = live & occupied & (kh == fp_hi) & (kl == fp_lo)
         done = done | match
-        cand = ~done & ~occupied
-        # Elect one winner per slot: lowest batch index (scatter-min is
-        # commutative => deterministic regardless of execution order).
-        claim = jnp.full((cap,), sentinel, dtype=jnp.int32)
-        claim = claim.at[slot].min(jnp.where(cand, ticket, sentinel))
-        winner = cand & (claim[slot] == ticket)
+        cand = live & ~match & ~occupied
+        # Elect one winner per claim index: lowest batch index (scatter-min
+        # is commutative => deterministic regardless of execution order).
+        # Same-slot candidates share a claim index, so winners have unique
+        # slots even when the buffer is smaller than the table.
+        cidx = (slot.astype(jnp.uint32) & cmask).astype(jnp.int32)
+        claim = jnp.full((claim_cap,), sentinel, dtype=jnp.int32)
+        claim = claim.at[cidx].min(jnp.where(cand, ticket, sentinel))
+        winner = cand & (claim[cidx] == ticket)
         # Winners have unique slots; their writes are conflict-free.
         # Losers are routed out of range and dropped.
         widx = jnp.where(winner, slot, cap)
@@ -99,19 +124,33 @@ def insert(
         val_lo_t = val_lo_t.at[widx].set(val_lo, mode="drop")
         is_new = is_new | winner
         done = done | winner
-        # Advance only probes blocked by a different key; election losers
-        # retry the same slot (they may be in-batch duplicates of the new
-        # winner and must observe its key next round).
-        bump = ~done & occupied & ~match
+        # Advance only probes blocked by a different key — and only those
+        # count against the max_probes budget. Election losers retry the
+        # same slot without spending budget (they may be in-batch
+        # duplicates of the new winner and must observe its key next
+        # round; their loss implies the winner completed, so rounds still
+        # make global progress).
+        bump = live & occupied & ~match
+        probes = probes + bump.astype(jnp.int32)
         slot = jnp.where(
             bump,
             ((slot.astype(jnp.uint32) + jnp.uint32(1)) & mask).astype(jnp.int32),
             slot,
         )
-        return slot, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t
+        return rnd + 1, slot, probes, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t
 
-    slot, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t = jax.lax.fori_loop(
-        0, max_probes, round_fn, (slot0, done0, is_new0, *hs)
+    def round_cond(carry):
+        rnd, _slot, probes, done, *_rest = carry
+        # Early exit once every element is resolved or out of probe
+        # budget. Every round either completes an element or bumps one
+        # toward its budget, so this terminates within m + max_probes
+        # rounds; `rnd` caps it absolutely as a belt-and-braces bound.
+        return (rnd < max_probes + m) & jnp.any(~done & (probes < max_probes))
+
+    _, slot, probes, done, is_new, key_hi, key_lo, val_hi_t, val_lo_t = (
+        jax.lax.while_loop(
+            round_cond, round_fn, (jnp.int32(0), slot0, probes0, done0, is_new0, *hs)
+        )
     )
     overflow = ~done
     return HashSet(key_hi, key_lo, val_hi_t, val_lo_t), is_new, overflow

@@ -124,7 +124,13 @@ class XlaChecker(Checker):
         self._disc_fp = jnp.zeros((self._P, 2), jnp.uint32)
         self._found_names: Dict[str, int] = {}  # name -> fp64, pinned on first find
         self._target_reached = False
-        self._superstep_cache: Dict[int, Any] = {}
+        # Compiled supersteps are a property of the MODEL (its kernels and
+        # properties), not of one checker run — cache on the model instance
+        # so repeated checks (bench warm/measure passes, retries) reuse
+        # compilations instead of paying a fresh XLA compile per bucket.
+        self._superstep_cache: Dict[Any, Any] = model.__dict__.setdefault(
+            "_xla_superstep_cache", {}
+        )
 
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.
@@ -308,8 +314,18 @@ class XlaChecker(Checker):
                 disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
                 disc_found = disc_found.at[i].set(disc_found[i] | has)
 
-            # 2. full action-grid expansion.
-            nxt, valid = jax.vmap(model.packed_step)(frontier)  # [F,A,W], [F,A]
+            # 2. full action-grid expansion. A model may return a third
+            #    per-action overflow mask: "this successor exists but does
+            #    not fit my codec" — the packed analogue of the reference's
+            #    capacity panics, surfaced loudly instead of silently
+            #    pruning the transition (SURVEY §7 hard part 2).
+            stepped = jax.vmap(model.packed_step)(frontier)  # [F,A,W], [F,A][, [F,A]]
+            if len(stepped) == 3:
+                nxt, valid, step_ovf = stepped
+                codec_overflow = jnp.any(step_ovf & f_valid[:, None])
+            else:
+                nxt, valid = stepped
+                codec_overflow = jnp.bool_(False)
             valid = valid & f_valid[:, None]
             step_states = jnp.sum(valid, dtype=jnp.int32)
 
@@ -362,15 +378,17 @@ class XlaChecker(Checker):
                 step_unique,
                 table_overflow,
                 frontier_overflow,
+                codec_overflow,
             )
 
         return jax.jit(superstep)
 
     def _superstep_for(self, f_cap: int):
-        fn = self._superstep_cache.get(f_cap)
+        key = (f_cap, self._symmetry, self._max_probes)
+        fn = self._superstep_cache.get(key)
         if fn is None:
             fn = self._build_superstep(f_cap)
-            self._superstep_cache[f_cap] = fn
+            self._superstep_cache[key] = fn
         return fn
 
     def _grow_table(self) -> None:
@@ -394,8 +412,20 @@ class XlaChecker(Checker):
             raise RuntimeError("rehash overflow — pathological fingerprint distribution")
         self._table = bigger
 
+    def _run_cap_for(self, n: int) -> int:
+        """Smallest power-of-FOUR run capacity with ~4x expansion headroom
+        over the live frontier, clamped to [1024, frontier_capacity].
+        Powers of four keep the compiled-bucket count low (each distinct
+        run capacity is a separate XLA compilation)."""
+        want = max(4 * max(n, 1), 1024)
+        cap = 1024
+        while cap < want:
+            cap *= 4
+        return min(cap, self._frontier_capacity)
+
     def _run_block(self, max_count: int = 1500) -> None:
         """One BFS level per call (level-synchronous super-step)."""
+        import jax
         import jax.numpy as jnp
 
         if self._target_reached or self._exhausted:
@@ -417,33 +447,77 @@ class XlaChecker(Checker):
         if self._visitor is not None:
             self._visit_frontier()
 
+        # Adaptive run capacity: BFS levels ramp up and down, but a fixed
+        # [frontier_capacity, A] expansion pays full freight on padding
+        # lanes every level. Run each level at the smallest compiled bucket
+        # with ~4x headroom over the live frontier; a frontier overflow
+        # retries at the next bucket (safe — the pre-step table is a
+        # functional value, untouched until we commit). The stored frontier
+        # keeps whatever row count the last level ran at (always >=
+        # frontier_count — every consumer slices [:frontier_count]); it is
+        # padded or sliced lazily to this level's bucket, so per-level cost
+        # is O(run_cap), not O(frontier_capacity).
+        run_cap = self._run_cap_for(self._frontier_count)
         while True:  # retried only on capacity growth
-            fn = self._superstep_for(self._frontier_capacity)
+            stored = self._frontier.shape[0]
+            if stored < run_cap:
+                f_in = jnp.concatenate(
+                    [
+                        self._frontier,
+                        jnp.zeros((run_cap - stored, self._W), jnp.uint32),
+                    ]
+                )
+                e_in = jnp.concatenate(
+                    [self._frontier_ebits, jnp.zeros((run_cap - stored,), jnp.uint32)]
+                )
+            elif stored > run_cap:
+                f_in = jax.lax.slice_in_dim(self._frontier, 0, run_cap)
+                e_in = jax.lax.slice_in_dim(self._frontier_ebits, 0, run_cap)
+            else:
+                f_in, e_in = self._frontier, self._frontier_ebits
+            fn = self._superstep_for(run_cap)
             out = fn(
-                self._frontier,
-                self._frontier_ebits,
+                f_in,
+                e_in,
                 self._frontier_count,
                 self._table,
                 self._disc_found,
                 self._disc_fp,
             )
-            (nf, ne, ncount, table, dfound, dfp, d_states, d_unique, t_ovf, f_ovf) = out
+            (
+                nf,
+                ne,
+                ncount,
+                table,
+                dfound,
+                dfp,
+                d_states,
+                d_unique,
+                t_ovf,
+                f_ovf,
+                c_ovf,
+            ) = out
+            if bool(c_ovf):
+                raise RuntimeError(
+                    f"{type(self._model).__name__}: packed-codec capacity "
+                    "overflow — a reachable successor does not fit the "
+                    "model's declared field widths/slot counts. Raise the "
+                    "model's capacity bounds (this is the loud failure the "
+                    "packed toolkit guarantees; see stateright_tpu.packing)."
+                )
             if bool(t_ovf):
                 # Functional arrays: the pre-step table is untouched; grow
                 # and re-run the same level.
                 self._grow_table()
                 continue
             if bool(f_ovf):
-                grown = self._frontier_capacity * 2
-                self._frontier = self._pad_rows(
-                    np.asarray(self._frontier)[: self._frontier_count], grown
-                )
-                ebits = np.zeros(grown, dtype=np.uint32)
-                ebits[: self._frontier_count] = np.asarray(self._frontier_ebits)[
-                    : self._frontier_count
-                ]
-                self._frontier_ebits = jnp.asarray(ebits)
-                self._frontier_capacity = grown
+                if run_cap < self._frontier_capacity:
+                    run_cap = min(run_cap * 4, self._frontier_capacity)
+                    continue
+                # The compaction output exceeded even the top bucket: raise
+                # the ceiling and retry the level at the new top.
+                self._frontier_capacity *= 2
+                run_cap = self._frontier_capacity
                 continue
             break
 

@@ -67,6 +67,57 @@ def test_hashset_inactive_lanes_ignored():
     assert np.asarray(found).tolist() == [True, False]
 
 
+def test_hashset_false_claim_conflicts_resolve():
+    # Batch-proportional election: the claim buffer is ~2*batch slots, so
+    # distinct table slots can share a claim index (here m=4 => claim_cap=16;
+    # slots 3 and 19 collide at index 3). The loser must retry and land on
+    # the next round — both keys insert, deterministically.
+    hs = hashset.make(1 << 12, jnp)
+
+    def fp_for_slot(slot, cap=1 << 12):
+        # slot = (hi ^ (lo * 0x9E3779B1)) & (cap-1); pick lo=0 => slot = hi & mask.
+        return np.uint32(slot), np.uint32(0)
+
+    pairs = [fp_for_slot(s) for s in (3, 19, 3 + 16 * 7, 1024 + 3)]
+    fp_hi = jnp.asarray(np.array([p[0] for p in pairs], dtype=np.uint32))
+    fp_lo = jnp.asarray(np.array([p[1] for p in pairs], dtype=np.uint32))
+    vals = jnp.asarray(np.array([1, 2, 3, 4], dtype=np.uint32))
+    hs, is_new, ovf = hashset.insert(hs, fp_hi, fp_lo, vals, vals, jnp.ones(4, bool))
+    assert np.asarray(is_new).tolist() == [True] * 4
+    assert not bool(ovf.any())
+    found, vh, _ = hashset.lookup(hs, fp_hi, fp_lo)
+    assert bool(found.all())
+    np.testing.assert_array_equal(np.asarray(vh), np.asarray(vals))
+
+
+def test_hashset_claim_buffer_is_batch_proportional():
+    # The jaxpr of an insert into a huge table must not materialize any
+    # O(capacity) temporary besides the table planes themselves: the claim
+    # buffer must be sized by the batch (here 2*64=128), not the 2^22 table.
+    import jax
+
+    cap = 1 << 22
+    m = 64
+    hs = hashset.make(cap, jnp)
+    args = (
+        jnp.ones(m, jnp.uint32),
+        jnp.arange(1, m + 1, dtype=jnp.uint32),
+        jnp.zeros(m, jnp.uint32),
+        jnp.zeros(m, jnp.uint32),
+        jnp.ones(m, bool),
+    )
+    jaxpr = jax.make_jaxpr(lambda t, *a: hashset.insert(t, *a))(hs, *args)
+    big = 0
+    for eqn in jaxpr.jaxpr.eqns:
+        for v in eqn.outvars:
+            shape = getattr(getattr(v, "aval", None), "shape", None)
+            if shape == (cap,) and eqn.primitive.name == "broadcast_in_dim":
+                big += 1
+    # The four table planes flow through while_loop untouched; no fresh
+    # [capacity] broadcast may appear (the old design created one per call).
+    assert big == 0, f"found {big} O(capacity) temporaries in insert jaxpr"
+
+
 def test_hashset_overflow_reported():
     hs = hashset.make(8, jnp)
     rng = np.random.default_rng(2)
