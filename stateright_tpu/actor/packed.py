@@ -5,10 +5,12 @@ because it implements ``Model`` (model.rs:200). On the device engine the
 extra requirement is the :class:`~stateright_tpu.xla.XlaChecker` PackedModel
 protocol: a fixed-width bit-packed transition kernel. This module provides
 
-- the packing pattern for actor systems: per-actor state fields + the
-  modeled network as a **bitmask over a closed envelope universe** (for
-  unordered-duplicating semantics a set-of-envelopes IS a bitmask; bounded
-  multisets/FIFOs use small counters per universe slot), and
+- the packing pattern for actor systems, built on the declarative
+  :mod:`stateright_tpu.packing` toolkit (``Layout`` bit-fields; for the
+  modeled network either a 1-bit-per-envelope bitset over a closed
+  universe — the natural codec for unordered-duplicating semantics — or a
+  :class:`~stateright_tpu.packing.SlotMultiset` for the non-duplicating
+  multiset), and
 - :class:`PackedPingPong`, the canonical fixture (actor_test_util.rs:4-126)
   in packed form, differentially tested against the object ``ActorModel``
   (exact 4,094-state parity on the lossy max=5 configuration,
@@ -17,7 +19,7 @@ protocol: a fixed-width bit-packed transition kernel. This module provides
 The wrapper *delegates* the object-level ``Model`` API to the underlying
 ``ActorModel``, so path reconstruction, the Explorer, and property lambdas
 see ordinary actor states; only the engine-facing ``packed_*`` kernels are
-hand-packed. This is the M3 milestone pattern (SURVEY.md §7): pack the
+layout-declared. This is the M3 milestone pattern (SURVEY.md §7): pack the
 state, keep the semantics.
 """
 
@@ -28,42 +30,46 @@ from typing import Any, List
 import numpy as np
 
 from ..core import Model
+from ..packing import LayoutBuilder
 from .actor_test_util import Ping, PingPongCfg, Pong, ping_pong_model
 from .model_state import ActorModelState
 from .network import Envelope, UnorderedDuplicatingNetwork
 from .timers import Timers
 from . import Id
 
-# word 0 layout: actor counts + history counters.
-_C0_SHIFT, _C1_SHIFT, _IN_SHIFT, _OUT_SHIFT = 0, 4, 8, 16
-_C_MASK, _H_MASK = 0xF, 0xFF
-# word 1 layout: Ping(v) presence at bit v, Pong(v) presence at bit 16+v.
-_PONG_SHIFT = 16
-
 
 class PackedPingPong(Model):
-    """The ping-pong ``ActorModel`` with a two-word packed codec.
+    """The ping-pong ``ActorModel`` with a toolkit-declared packed codec.
 
     Supports the unordered-duplicating network (the ``ActorModel`` default),
-    lossy or lossless, with or without history. ``max_nat`` must fit the
-    4-bit count fields (<= 14) and the 16 envelope-value slots (<= 14).
+    lossy or lossless, with or without history. The envelope universe is
+    closed — Ping(v)/Pong(v) for v in 0..max_nat (the boundary caps actor
+    counts, so no larger value is ever sent) — so the network packs as one
+    presence bit per universe envelope: for duplicating semantics a set of
+    envelopes IS a bitset (network.rs:51-52).
     """
 
-    state_words = 2
-
     def __init__(self, cfg: PingPongCfg, lossy: bool = False):
-        if cfg.max_nat > 14:
-            raise ValueError("max_nat > 14 exceeds the packed field widths")
         self.cfg = cfg
         self.lossy = lossy
         inner = ping_pong_model(cfg)
         if lossy:
             inner = inner.lossy_network(True)
         self._inner = inner
-        # Envelope-value universe: Ping(v)/Pong(v) for v in 0..max_nat
-        # (boundary caps actor counts at max_nat, so no larger value is
-        # ever sent; see the step kernel's boundary mask).
         self._V = cfg.max_nat + 1
+        # Universe envelope codes: Ping(v) = 2v (actor0 -> actor1),
+        # Pong(v) = 2v+1 (actor1 -> actor0).
+        count_bits = max(cfg.max_nat.bit_length() + 1, 1)
+        self._layout = (
+            LayoutBuilder()
+            .uint("c0", count_bits)
+            .uint("c1", count_bits)
+            .uint("hin", 2 * count_bits)
+            .uint("hout", 2 * count_bits)
+            .array("net", 2 * self._V, 1)
+            .finish()
+        )
+        self.state_words = self._layout.words
         # Action grid: deliver each universe envelope (+ drop it if lossy).
         self.max_actions = (2 if lossy else 1) * 2 * self._V
 
@@ -89,39 +95,34 @@ class PackedPingPong(Model):
 
     # --- codec -------------------------------------------------------------
 
+    def _env_code(self, env: Envelope) -> int:
+        if isinstance(env.msg, Ping):
+            return 2 * env.msg.value
+        return 2 * env.msg.value + 1
+
+    def _code_env(self, code: int) -> Envelope:
+        v, is_pong = divmod(code, 2)[0], code % 2
+        if is_pong:
+            return Envelope(Id(1), Id(0), Pong(v))
+        return Envelope(Id(0), Id(1), Ping(v))
+
     def pack(self, state: ActorModelState) -> np.ndarray:
         c0, c1 = state.actor_states
         hist_in, hist_out = state.history if state.history else (0, 0)
-        w0 = (
-            (c0 & _C_MASK)
-            | ((c1 & _C_MASK) << _C1_SHIFT)
-            | ((hist_in & _H_MASK) << _IN_SHIFT)
-            | ((hist_out & _H_MASK) << _OUT_SHIFT)
-        )
-        w1 = 0
+        net = [0] * (2 * self._V)
         for env in state.network.envelopes:
-            if isinstance(env.msg, Ping):
-                w1 |= 1 << env.msg.value
-            else:
-                w1 |= 1 << (_PONG_SHIFT + env.msg.value)
-        return np.asarray([w0, w1], dtype=np.uint32)
+            net[self._env_code(env)] = 1
+        return self._layout.pack(c0=c0, c1=c1, hin=hist_in, hout=hist_out, net=net)
 
     def unpack(self, words) -> ActorModelState:
-        w0, w1 = (int(w) for w in words)
-        envs = []
-        for v in range(self._V):
-            if (w1 >> v) & 1:
-                envs.append(Envelope(Id(0), Id(1), Ping(v)))
-            if (w1 >> (_PONG_SHIFT + v)) & 1:
-                envs.append(Envelope(Id(1), Id(0), Pong(v)))
+        f = self._layout.unpack(words)
+        envs = [self._code_env(c) for c, bit in enumerate(f["net"]) if bit]
         return ActorModelState(
-            actor_states=(w0 & _C_MASK, (w0 >> _C1_SHIFT) & _C_MASK),
+            actor_states=(f["c0"], f["c1"]),
             network=UnorderedDuplicatingNetwork(frozenset(envs)),
             timers_set=(Timers(), Timers()),
             history=(
-                ((w0 >> _IN_SHIFT) & _H_MASK, (w0 >> _OUT_SHIFT) & _H_MASK)
-                if self.cfg.maintains_history
-                else (0, 0)
+                (f["hin"], f["hout"]) if self.cfg.maintains_history else (0, 0)
             ),
         )
 
@@ -137,44 +138,43 @@ class PackedPingPong(Model):
         a drop per envelope when lossy."""
         import jax.numpy as jnp
 
+        L = self._layout
         u = jnp.uint32
-        w0, w1 = words[0], words[1]
-        c0 = w0 & u(_C_MASK)
-        c1 = (w0 >> u(_C1_SHIFT)) & u(_C_MASK)
+        c0 = L.get(words, "c0")
+        c1 = L.get(words, "c1")
         max_nat = u(self.cfg.max_nat)
-        hist_bump = (
-            u((1 << _IN_SHIFT) | (1 << _OUT_SHIFT))
-            if self.cfg.maintains_history
-            else u(0)
-        )
+        keeps_history = self.cfg.maintains_history
 
         nxt, valid = [], []
         for v in range(self._V):
             uv = u(v)
             # Deliver Ping(v) to actor 1 (actor_test_util.rs on_msg): bump
             # its count, reply Pong(v). Dup network: the Ping bit stays.
-            present = ((w1 >> uv) & u(1)) != 0
-            effective = present & (c1 == uv)
-            ok = effective & (c1 + u(1) <= max_nat)
-            n_w0 = w0 + (u(1) << u(_C1_SHIFT)) + hist_bump
-            n_w1 = w1 | (u(1) << (uv + u(_PONG_SHIFT)))
-            nxt.append(jnp.stack([n_w0, n_w1]))
+            present = L.get(words, "net", 2 * v) != 0
+            ok = present & (c1 == uv) & (c1 + u(1) <= max_nat)
+            w = L.set(words, "c1", c1 + u(1))
+            if keeps_history:
+                w = L.set(w, "hin", L.get(w, "hin") + u(1))
+                w = L.set(w, "hout", L.get(w, "hout") + u(1))
+            w = L.set(w, "net", 1, 2 * v + 1)  # send Pong(v)
+            nxt.append(w)
             valid.append(ok)
             # Deliver Pong(v) to actor 0: bump its count, send Ping(v+1).
-            present = ((w1 >> (uv + u(_PONG_SHIFT))) & u(1)) != 0
-            effective = present & (c0 == uv)
-            ok = effective & (c0 + u(1) <= max_nat)
-            n_w0 = w0 + u(1) + hist_bump
-            n_w1 = w1 | (u(1) << (uv + u(1)))
-            nxt.append(jnp.stack([n_w0, n_w1]))
+            present = L.get(words, "net", 2 * v + 1) != 0
+            ok = present & (c0 == uv) & (c0 + u(1) <= max_nat)
+            w = L.set(words, "c0", c0 + u(1))
+            if keeps_history:
+                w = L.set(w, "hin", L.get(w, "hin") + u(1))
+                w = L.set(w, "hout", L.get(w, "hout") + u(1))
+            if v + 1 < self._V:
+                w = L.set(w, "net", 1, 2 * (v + 1))  # send Ping(v+1)
+            nxt.append(w)
             valid.append(ok)
         if self.lossy:
-            for v in range(self._V):
-                for bit in (v, _PONG_SHIFT + v):
-                    present = ((w1 >> u(bit)) & u(1)) != 0
-                    n_w1 = w1 & ~(u(1) << u(bit))
-                    nxt.append(jnp.stack([w0, n_w1]))
-                    valid.append(present)
+            for code in range(2 * self._V):
+                present = L.get(words, "net", code) != 0
+                nxt.append(L.set(words, "net", 0, code))
+                valid.append(present)
         return jnp.stack(nxt), jnp.stack(valid)
 
     def packed_properties(self, words):
@@ -182,12 +182,12 @@ class PackedPingPong(Model):
         ``properties()`` order."""
         import jax.numpy as jnp
 
+        L = self._layout
         u = jnp.uint32
-        w0 = words[0]
-        c0 = w0 & u(_C_MASK)
-        c1 = (w0 >> u(_C1_SHIFT)) & u(_C_MASK)
-        hist_in = (w0 >> u(_IN_SHIFT)) & u(_H_MASK)
-        hist_out = (w0 >> u(_OUT_SHIFT)) & u(_H_MASK)
+        c0 = L.get(words, "c0")
+        c1 = L.get(words, "c1")
+        hist_in = L.get(words, "hin")
+        hist_out = L.get(words, "hout")
         max_nat = u(self.cfg.max_nat)
         delta_ok = jnp.where(c0 > c1, c0 - c1, c1 - c0) <= u(1)
         at_max = (c0 == max_nat) | (c1 == max_nat)

@@ -1,0 +1,645 @@
+"""Reusable bit-packing toolkit for device-checkable (Packed) models.
+
+The reference needs no per-model engine code: any ``Model`` works because
+states live on the heap (``/root/reference/src/lib.rs:155-254``).  The device
+engine instead needs fixed-width states, and round 1 hand-rolled a bespoke
+codec per model (~200 LoC of shift arithmetic each).  This module is the
+generic replacement: models *declare* layouts and get host pack/unpack and
+jnp-traceable device accessors, with loud overflow detection (the packed
+analogue of the reference's panics on broken invariants).
+
+Pieces, bottom-up:
+
+- :class:`Layout` / :class:`LayoutBuilder` — named bit-fields over uint32
+  words.  Fields never span word boundaries; array fields are uniformly
+  strided so a *traced* index can address them on device.
+- :class:`SlotMultiset` — the fixed-width form of the non-duplicating
+  multiset network (``network.rs:54-55``): K word-sized slots, each
+  ``code << count_bits | count``, kept sorted so equal multisets pack to
+  equal words (the packed analogue of the order-insensitive hashing in
+  ``util.rs:134-156``).  ``count_bits=0`` degrades to a duplicating *set*
+  (``network.rs:51-52``).
+- :class:`FifoLanes` — the ordered network (``network.rs:57-67``): one
+  bounded FIFO lane per directed flow; only heads are deliverable.
+- :class:`BoundedHistory` — a fixed-width encoding of the backtracking
+  consistency testers (``semantics/linearizability.rs:57-126``) for
+  clients with statically bounded operation counts; converts exactly
+  to/from :class:`~stateright_tpu.semantics._backtracking.BacktrackingTester`
+  so packed actor models can carry the same auxiliary history the object
+  models do.
+
+Everything device-side is functional: ops take and return the state's word
+vector ``words[W]`` (uint32) and fuse into the engine superstep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Field(NamedTuple):
+    name: str
+    bits: int  # bits per element
+    count: int  # number of elements (1 for scalars)
+    word: int  # first word index
+    shift: int  # bit offset of element 0 in its word
+    epw: int  # elements per word (array fields are word-aligned)
+    is_array: bool  # declared via array()/words(): list-valued in pack/unpack
+
+
+class OverflowError32(RuntimeError):
+    """A value exceeded its declared field width at host pack time."""
+
+
+class LayoutBuilder:
+    """Accumulates fields; ``finish()`` freezes them into a :class:`Layout`.
+
+    Scalars pack densely left-to-right within words.  Array fields are
+    word-aligned with a fixed stride (``32 // bits`` elements per word) so
+    device code can address element ``i`` with traced ``i``.
+    """
+
+    def __init__(self) -> None:
+        self._fields: Dict[str, Field] = {}
+        self._word = 0
+        self._bit = 0
+
+    def _align_word(self) -> None:
+        if self._bit:
+            self._word += 1
+            self._bit = 0
+
+    def uint(self, name: str, bits: int) -> "LayoutBuilder":
+        """A scalar field of ``bits`` (1..32) bits."""
+        if not 1 <= bits <= 32:
+            raise ValueError(f"field {name}: bits must be 1..32, got {bits}")
+        if name in self._fields:
+            raise ValueError(f"duplicate field {name}")
+        if self._bit + bits > 32:
+            self._align_word()
+        self._fields[name] = Field(
+            name, bits, 1, self._word, self._bit, max(32 // bits, 1), False
+        )
+        self._bit += bits
+        if self._bit == 32:
+            self._align_word()
+        return self
+
+    def flag(self, name: str) -> "LayoutBuilder":
+        return self.uint(name, 1)
+
+    def array(self, name: str, count: int, bits: int) -> "LayoutBuilder":
+        """``count`` elements of ``bits`` bits, word-aligned, uniformly
+        strided (indexable with a traced index on device)."""
+        if not 1 <= bits <= 32:
+            raise ValueError(f"field {name}: bits must be 1..32, got {bits}")
+        if name in self._fields:
+            raise ValueError(f"duplicate field {name}")
+        self._align_word()
+        epw = 32 // bits
+        self._fields[name] = Field(name, bits, count, self._word, 0, epw, True)
+        self._word += (count + epw - 1) // epw
+        return self
+
+    def words(self, name: str, count: int) -> "LayoutBuilder":
+        """``count`` full uint32 words (for sub-codecs like SlotMultiset)."""
+        return self.array(name, count, 32)
+
+    def finish(self) -> "Layout":
+        self._align_word()
+        return Layout(dict(self._fields), self._word)
+
+
+class Layout:
+    def __init__(self, fields: Dict[str, Field], words: int):
+        self.fields = fields
+        self.words = words
+
+    # --- device/host accessors (xp-agnostic: jnp under trace, np on host) --
+
+    def get(self, words, name: str, idx: Any = 0):
+        """Read field ``name`` (element ``idx`` for arrays). ``idx`` may be
+        a traced value for array fields."""
+        f = self.fields[name]
+        if f.bits == 32:
+            return words[f.word + idx]
+        mask = np.uint32((1 << f.bits) - 1)
+        if not f.is_array:
+            return (words[f.word] >> np.uint32(f.shift)) & mask
+        w = f.word + idx // f.epw
+        sh = (idx % f.epw) * f.bits
+        return (words[w] >> _u32(sh)) & mask
+
+    def set(self, words, name: str, value, idx: Any = 0):
+        """Return a new word vector with field ``name`` set. jnp path only
+        (host packing goes through :meth:`pack`)."""
+        f = self.fields[name]
+        mask = np.uint32((1 << f.bits) - 1) if f.bits < 32 else np.uint32(0xFFFFFFFF)
+        value = _u32(value) & mask
+        if f.bits == 32:
+            return words.at[f.word + idx].set(value)
+        if not f.is_array:
+            w = f.word
+            sh = np.uint32(f.shift)
+            inv = np.uint32(~(int(mask) << f.shift) & 0xFFFFFFFF)
+            return words.at[w].set((words[w] & inv) | (value << sh))
+        w = f.word + idx // f.epw
+        sh = _u32((idx % f.epw) * f.bits)
+        cleared = words[w] & ~(_u32(mask) << sh)
+        return words.at[w].set(cleared | (value << sh))
+
+    # --- host codec --------------------------------------------------------
+
+    def pack(self, **values: Any) -> np.ndarray:
+        """Pack named values (ints, or sequences for array fields) into a
+        fresh word vector; unset fields are zero. Overflow raises."""
+        out = np.zeros(self.words, dtype=np.uint32)
+        for name, value in values.items():
+            f = self.fields[name]
+            elems = list(value) if f.is_array else [value]
+            if len(elems) > f.count:
+                raise OverflowError32(f"{name}: {len(elems)} elements > {f.count}")
+            limit = 1 << f.bits
+            for i, v in enumerate(elems):
+                v = int(v)
+                if not 0 <= v < limit:
+                    raise OverflowError32(
+                        f"{name}[{i}] = {v} exceeds {f.bits}-bit field"
+                    )
+                w = f.word + i // f.epw
+                sh = (i % f.epw) * f.bits if f.is_array else f.shift
+                out[w] |= np.uint32(v << sh)
+        return out
+
+    def unpack(self, words) -> Dict[str, Any]:
+        """Host inverse of :meth:`pack`: field name -> int or list of ints."""
+        words = [int(w) for w in words]
+        out: Dict[str, Any] = {}
+        for name, f in self.fields.items():
+            mask = (1 << f.bits) - 1 if f.bits < 32 else 0xFFFFFFFF
+            if not f.is_array:
+                out[name] = (words[f.word] >> f.shift) & mask
+            else:
+                out[name] = [
+                    (words[f.word + i // f.epw] >> ((i % f.epw) * f.bits)) & mask
+                    for i in range(f.count)
+                ]
+        return out
+
+
+def _u32(x):
+    """Coerce to uint32 under either numpy or jax tracing."""
+    if isinstance(x, (int, np.integer)):
+        return np.uint32(x)
+    import jax.numpy as jnp
+
+    return x.astype(jnp.uint32) if hasattr(x, "astype") else jnp.uint32(x)
+
+
+# --------------------------------------------------------------------------
+# Sorted-slot multiset: the packed non-duplicating network.
+# --------------------------------------------------------------------------
+
+
+class SlotMultiset:
+    """K word-sized slots holding a canonical (sorted) multiset of envelope
+    codes.
+
+    Slot encoding: ``(code + 1) << count_bits | count`` — the +1 reserves 0
+    for EMPTY even for code 0. ``count`` is stored as count-1 (a present
+    slot has count >= 1), so ``count_bits`` caps multiplicity at
+    ``2**count_bits``. ``count_bits=0`` is the duplicating-set variant:
+    presence only, deliver keeps the slot (redeliverable, network.rs:204).
+
+    The slots are a view over a ``Layout`` words-field named ``field``;
+    all ops return updated full word vectors, keeping slots sorted
+    ascending (EMPTY=0 slots first) so equal multisets have equal words.
+    """
+
+    def __init__(self, layout: Layout, field: str, code_bits: int, count_bits: int):
+        f = layout.fields[field]
+        if f.bits != 32:
+            raise ValueError("SlotMultiset requires a words() field")
+        if code_bits + 1 + count_bits > 32:
+            raise ValueError("code_bits + count_bits must fit a word (with +1 code)")
+        self.layout = layout
+        self.field = field
+        self.k = f.count
+        self.base = f.word
+        self.code_bits = code_bits
+        self.count_bits = count_bits
+        self.max_count = 1 << count_bits
+
+    # --- device ops --------------------------------------------------------
+
+    def slots(self, words):
+        import jax.numpy as jnp
+
+        return jnp.asarray(words[self.base : self.base + self.k])
+
+    def _with_slots(self, words, slots):
+        import jax.numpy as jnp
+
+        slots = jnp.sort(slots)  # canonical: EMPTY(0) first, then by code
+        return words.at[self.base : self.base + self.k].set(slots)
+
+    def decode(self, slots):
+        """(codes[K], counts[K], present[K]) from raw slots."""
+        import jax.numpy as jnp
+
+        cb = jnp.uint32(self.count_bits)
+        present = slots != 0
+        codes = (slots >> cb) - jnp.where(present, jnp.uint32(1), jnp.uint32(0))
+        counts = jnp.where(
+            present, (slots & jnp.uint32(self.max_count - 1)) + jnp.uint32(1), 0
+        ).astype(jnp.uint32)
+        return codes, counts, present
+
+    def send(self, words, code, enabled=True):
+        """Add one instance of ``code``; returns ``(words', overflow)``.
+        Overflow = no free slot for a new code, or count saturated."""
+        import jax.numpy as jnp
+
+        enabled = jnp.asarray(enabled)
+        s = self.slots(words)
+        cb = jnp.uint32(self.count_bits)
+        code = _u32(code)
+        encoded = (code + jnp.uint32(1)) << cb
+        present = s != 0
+        match = present & ((s >> cb) == (code + jnp.uint32(1)))
+        has = jnp.any(match)
+        if self.count_bits == 0:
+            # Duplicating set: membership only.
+            bumped = s
+            count_ovf = jnp.bool_(False)
+        else:
+            at_max = match & (
+                (s & jnp.uint32(self.max_count - 1)) == jnp.uint32(self.max_count - 1)
+            )
+            count_ovf = jnp.any(at_max)
+            # A saturated count must NOT bump: the +1 would carry into the
+            # code bits and decode as a different envelope. The word stays
+            # unchanged and only the overflow flag reports the problem.
+            bumped = jnp.where(match & ~at_max, s + jnp.uint32(1), s)
+        first_empty = jnp.argmin(jnp.where(present, 1, 0))  # slots sorted: empties first
+        can_insert = ~present[first_empty]
+        inserted = s.at[first_empty].set(encoded)
+        s_new = jnp.where(has, bumped, jnp.where(can_insert, inserted, s))
+        overflow = enabled & jnp.where(has, count_ovf, ~can_insert)
+        s_new = jnp.where(enabled, s_new, s)
+        return self._with_slots(words, s_new), overflow
+
+    def remove_slot(self, words, i, enabled=True):
+        """Remove one instance from slot ``i`` (deliver on a non-duplicating
+        network, or drop); returns ``words'``. No-op when disabled."""
+        import jax.numpy as jnp
+
+        enabled = jnp.asarray(enabled)
+        s = self.slots(words)
+        si = s[i]
+        last = (si & jnp.uint32(self.max_count - 1)) == 0 if self.count_bits else jnp.bool_(True)
+        new_si = jnp.where(last, jnp.uint32(0), si - jnp.uint32(1))
+        s = s.at[i].set(jnp.where(enabled, new_si, si))
+        return self._with_slots(words, s)
+
+    # --- host codec --------------------------------------------------------
+
+    def host_pack(self, code_counts: Sequence[Tuple[int, int]]) -> List[int]:
+        """Sorted slot words from (code, count) pairs; raises loudly on
+        capacity or width overflow (SURVEY §7 hard part 2)."""
+        if len(code_counts) > self.k:
+            raise OverflowError32(
+                f"{len(code_counts)} distinct envelopes > {self.k} slots"
+            )
+        slots = []
+        for code, count in code_counts:
+            if not 0 <= code < (1 << self.code_bits):
+                raise OverflowError32(f"envelope code {code} exceeds {self.code_bits} bits")
+            if not 1 <= count <= self.max_count:
+                raise OverflowError32(
+                    f"envelope count {count} outside 1..{self.max_count}"
+                )
+            slots.append(((code + 1) << self.count_bits) | (count - 1))
+        slots.sort()
+        return [0] * (self.k - len(slots)) + slots
+
+    def host_unpack(self, slot_words: Sequence[int]) -> List[Tuple[int, int]]:
+        out = []
+        for s in slot_words:
+            s = int(s)
+            if s == 0:
+                continue
+            code = (s >> self.count_bits) - 1
+            count = (s & (self.max_count - 1)) + 1 if self.count_bits else 1
+            out.append((code, count))
+        return out
+
+
+# --------------------------------------------------------------------------
+# FIFO lanes: the packed ordered network.
+# --------------------------------------------------------------------------
+
+
+class FifoLanes:
+    """P directed flows, each a bounded FIFO of up to ``depth`` message
+    codes (the packed ``Ordered`` network, network.rs:57-67). Only lane
+    heads are deliverable; deliver pops the head and shifts.
+
+    Codes are stored +1 (0 = empty cell) in a strided array field of
+    ``depth`` elements per lane, plus a length field per lane.
+    """
+
+    def __init__(
+        self, builder: LayoutBuilder, name: str, lanes: int, depth: int, code_bits: int
+    ):
+        if code_bits + 1 > 32:
+            raise ValueError("code_bits must leave room for the +1 empty sentinel")
+        self.lanes = lanes
+        self.depth = depth
+        self.code_bits = code_bits
+        self.cells = f"{name}_cells"
+        self.lens = f"{name}_lens"
+        builder.array(self.cells, lanes * depth, min(code_bits + 1, 32))
+        builder.array(self.lens, lanes, max(depth.bit_length(), 1))
+        self.layout: Optional[Layout] = None  # bound by finish()
+
+    def bind(self, layout: Layout) -> "FifoLanes":
+        self.layout = layout
+        return self
+
+    # --- device ops --------------------------------------------------------
+
+    def length(self, words, lane):
+        return self.layout.get(words, self.lens, lane)
+
+    def head(self, words, lane):
+        """(code, nonempty) of the lane head."""
+        import jax.numpy as jnp
+
+        raw = self.layout.get(words, self.cells, lane * self.depth)
+        return raw - jnp.uint32(1), raw != 0
+
+    def push(self, words, lane, code, enabled=True):
+        """Append ``code``; returns (words', overflow)."""
+        import jax.numpy as jnp
+
+        enabled = jnp.asarray(enabled)
+        n = self.length(words, lane)
+        overflow = enabled & (n >= jnp.uint32(self.depth))
+        ok = enabled & ~overflow
+        idx = lane * self.depth + jnp.minimum(n, jnp.uint32(self.depth - 1)).astype(jnp.int32)
+        old_cell = self.layout.get(words, self.cells, idx)
+        new_cell = jnp.where(ok, _u32(code) + jnp.uint32(1), old_cell)
+        words = self.layout.set(words, self.cells, new_cell, idx)
+        words = self.layout.set(
+            words, self.lens, jnp.where(ok, n + jnp.uint32(1), n), lane
+        )
+        return words, overflow
+
+    def pop(self, words, lane, enabled=True):
+        """Pop the head (deliver/drop); shifts the lane. Returns words'."""
+        import jax.numpy as jnp
+
+        enabled = jnp.asarray(enabled)
+        n = self.length(words, lane)
+        do = enabled & (n > 0)
+        for j in range(self.depth - 1):
+            idx = lane * self.depth + j
+            nxt = self.layout.get(words, self.cells, idx + 1)
+            cur = self.layout.get(words, self.cells, idx)
+            words = self.layout.set(words, self.cells, jnp.where(do, nxt, cur), idx)
+        tail = lane * self.depth + (self.depth - 1)
+        cur = self.layout.get(words, self.cells, tail)
+        words = self.layout.set(
+            words, self.cells, jnp.where(do, jnp.uint32(0), cur), tail
+        )
+        words = self.layout.set(
+            words, self.lens, jnp.where(do, n - jnp.uint32(1), n), lane
+        )
+        return words
+
+    # --- host codec --------------------------------------------------------
+
+    def host_pack_lane(self, codes: Sequence[int]) -> Tuple[List[int], int]:
+        if len(codes) > self.depth:
+            raise OverflowError32(f"{len(codes)} queued messages > depth {self.depth}")
+        for c in codes:
+            if not 0 <= c < (1 << self.code_bits):
+                raise OverflowError32(f"message code {c} exceeds {self.code_bits} bits")
+        cells = [c + 1 for c in codes] + [0] * (self.depth - len(codes))
+        return cells, len(codes)
+
+
+# --------------------------------------------------------------------------
+# Bounded consistency-tester history.
+# --------------------------------------------------------------------------
+
+
+class BoundedHistory:
+    """Fixed-width encoding of a :class:`BacktrackingTester` whose threads
+    and per-thread operation counts are statically bounded (register-style
+    scripted clients, register.rs:94-260).
+
+    Per thread t (identified by its position in ``thread_ids``):
+      - ``h{t}_n``        completed-op count (0..max_ops)
+      - ``h{t}_fl``       in-flight op code + 1 (0 = none)
+      - ``h{t}_flpre``    per-peer prereq index + 2 at invocation
+                          (0 = no entry; the tester omits peers with empty
+                          history, linearizability.rs:114-126)
+      - ``h{t}_op/_ret``  completed op/ret codes (+1; 0 unused)
+      - ``h{t}_pre``      per-(slot, peer) prereq index + 2
+      - ``h_valid``       the is_valid_history poison bit
+
+    Op/ret codes are model-supplied small ints (closed universes).
+    Conversion to/from the live tester object is exact, so packed states
+    fingerprint-distinguish histories exactly like object states do.
+    """
+
+    def __init__(
+        self,
+        builder: LayoutBuilder,
+        thread_ids: Sequence[Any],
+        max_ops: int,
+        op_bits: int,
+        ret_bits: int,
+    ):
+        self.thread_ids = list(thread_ids)
+        self.max_ops = max_ops
+        self.op_bits = op_bits
+        self.ret_bits = ret_bits
+        T = len(self.thread_ids)
+        self.peers = {
+            t: [p for p in range(T) if p != t] for t in range(T)
+        }
+        pre_bits = max((max_ops + 2).bit_length(), 2)
+        self.pre_bits = pre_bits
+        builder.flag("h_valid")
+        for t in range(T):
+            builder.uint(f"h{t}_n", max(max_ops.bit_length(), 1))
+            builder.uint(f"h{t}_fl", op_bits + 1)
+            builder.array(f"h{t}_flpre", max(T - 1, 1), pre_bits)
+            builder.array(f"h{t}_op", max_ops, op_bits + 1)
+            builder.array(f"h{t}_ret", max_ops, ret_bits + 1)
+            builder.array(f"h{t}_pre", max(max_ops * (T - 1), 1), pre_bits)
+        self.layout: Optional[Layout] = None
+
+    def bind(self, layout: Layout) -> "BoundedHistory":
+        self.layout = layout
+        return self
+
+    # --- device ops --------------------------------------------------------
+
+    def init_words(self, words):
+        """Mark the empty history valid."""
+        return self.layout.set(words, "h_valid", 1)
+
+    def on_invoke(self, words, t: int, op_code, enabled=True):
+        """Record an invocation on (static) thread ``t``: op in flight +
+        real-time prereqs snapshot (linearizability.rs:114-126).
+
+        An invoke while another op is in flight is a *protocol* violation:
+        the tester poisons ``is_valid_history`` (consistency_tester
+        HistoryError semantics) and so does this — ``h_valid`` is cleared,
+        matching how ``record_invocations`` swallows the HistoryError but
+        keeps the poisoned tester."""
+        import jax.numpy as jnp
+
+        enabled = jnp.asarray(enabled)
+        L = self.layout
+        cur = L.get(words, f"h{t}_fl")
+        misuse = enabled & (cur != 0)
+        valid = L.get(words, "h_valid")
+        words = L.set(
+            words, "h_valid", jnp.where(misuse, jnp.uint32(0), valid)
+        )
+        do = enabled & ~misuse
+        new = jnp.where(do, _u32(op_code) + jnp.uint32(1), cur)
+        words = L.set(words, f"h{t}_fl", new)
+        for pi, p in enumerate(self.peers[t]):
+            pn = L.get(words, f"h{p}_n")
+            # Tester semantics: peers with no completed ops are absent.
+            pre = jnp.where(pn > 0, pn + jnp.uint32(1), jnp.uint32(0))  # (n-1)+2
+            cur = L.get(words, f"h{t}_flpre", pi)
+            words = L.set(words, f"h{t}_flpre", jnp.where(do, pre, cur), pi)
+        return words
+
+    def on_return(self, words, t: int, ret_code, enabled=True):
+        """Record a return on thread ``t``: moves the in-flight op (with its
+        prereqs) into the completed list. Returns ``(words', overflow)``.
+
+        ``overflow`` is True when the completed list is full (the static
+        ``max_ops`` bound is too small for a reachable history) — models
+        must route it into ``packed_step``'s overflow output so the engine
+        fails loudly instead of silently truncating the history. A return
+        with no in-flight op is a protocol violation and poisons
+        ``h_valid`` like the tester does."""
+        import jax.numpy as jnp
+
+        enabled = jnp.asarray(enabled)
+        L = self.layout
+        n = L.get(words, f"h{t}_n").astype(jnp.int32)
+        fl = L.get(words, f"h{t}_fl")
+        slot = jnp.minimum(n, self.max_ops - 1)
+        misuse = enabled & (fl == 0)
+        overflow = enabled & (fl != 0) & (n >= self.max_ops)
+        valid = L.get(words, "h_valid")
+        words = L.set(words, "h_valid", jnp.where(misuse, jnp.uint32(0), valid))
+        do = enabled & (fl != 0) & (n < self.max_ops)
+        cur_op = L.get(words, f"h{t}_op", slot)
+        words = L.set(words, f"h{t}_op", jnp.where(do, fl, cur_op), slot)
+        cur_ret = L.get(words, f"h{t}_ret", slot)
+        words = L.set(
+            words, f"h{t}_ret", jnp.where(do, _u32(ret_code) + jnp.uint32(1), cur_ret), slot
+        )
+        npeer = max(len(self.peers[t]), 1)
+        for pi, _ in enumerate(self.peers[t]):
+            pre = L.get(words, f"h{t}_flpre", pi)
+            idx = slot * npeer + pi
+            cur = L.get(words, f"h{t}_pre", idx)
+            words = L.set(words, f"h{t}_pre", jnp.where(do, pre, cur), idx)
+            words = L.set(words, f"h{t}_flpre", jnp.where(do, jnp.uint32(0), pre), pi)
+        words = L.set(words, f"h{t}_fl", jnp.where(do, jnp.uint32(0), fl))
+        words = L.set(
+            words,
+            f"h{t}_n",
+            jnp.where(do, (n + 1).astype(jnp.uint32), n.astype(jnp.uint32)),
+        )
+        return words, overflow
+
+    # --- host codec --------------------------------------------------------
+
+    def from_tester(self, tester, op_code, ret_code) -> Dict[str, Any]:
+        """Field values for :meth:`Layout.pack` from a live tester.
+        ``op_code``/``ret_code`` map op/ret objects to closed-universe ints."""
+        T = len(self.thread_ids)
+        values: Dict[str, Any] = {"h_valid": 1 if tester.is_valid_history else 0}
+        for t in range(T):
+            tid = self.thread_ids[t]
+            completed = tester.history_by_thread.get(tid, [])
+            if len(completed) > self.max_ops:
+                raise OverflowError32(
+                    f"thread {tid!r}: {len(completed)} completed ops > {self.max_ops}"
+                )
+            values[f"h{t}_n"] = len(completed)
+            ops, rets, pres = [0] * self.max_ops, [0] * self.max_ops, [0] * max(
+                self.max_ops * (T - 1), 1
+            )
+            for j, (prereqs, op, ret) in enumerate(completed):
+                ops[j] = op_code(op) + 1
+                rets[j] = ret_code(ret) + 1
+                for pi, p in enumerate(self.peers[t]):
+                    pid = self.thread_ids[p]
+                    if pid in prereqs:
+                        pres[j * max(T - 1, 1) + pi] = prereqs[pid] + 2
+            values[f"h{t}_op"] = ops
+            values[f"h{t}_ret"] = rets
+            values[f"h{t}_pre"] = pres
+            flpre = [0] * max(T - 1, 1)
+            if tid in tester.in_flight_by_thread:
+                prereqs, op = tester.in_flight_by_thread[tid]
+                values[f"h{t}_fl"] = op_code(op) + 1
+                for pi, p in enumerate(self.peers[t]):
+                    pid = self.thread_ids[p]
+                    if pid in prereqs:
+                        flpre[pi] = prereqs[pid] + 2
+            else:
+                values[f"h{t}_fl"] = 0
+            values[f"h{t}_flpre"] = flpre
+        return values
+
+    def to_tester(self, fields: Dict[str, Any], make_tester, code_op, code_ret):
+        """Rebuild the tester from :meth:`Layout.unpack` output.
+        ``make_tester()`` builds an empty tester; ``code_op``/``code_ret``
+        invert the code maps."""
+        tester = make_tester()
+        tester.is_valid_history = bool(fields["h_valid"])
+        T = len(self.thread_ids)
+        for t in range(T):
+            tid = self.thread_ids[t]
+            n = fields[f"h{t}_n"]
+            if n > 0 or fields[f"h{t}_fl"] != 0:
+                tester.history_by_thread.setdefault(tid, [])
+            for j in range(n):
+                prereqs = {}
+                for pi, p in enumerate(self.peers[t]):
+                    raw = fields[f"h{t}_pre"][j * max(T - 1, 1) + pi]
+                    if raw:
+                        prereqs[self.thread_ids[p]] = raw - 2
+                tester.history_by_thread[tid].append(
+                    (
+                        prereqs,
+                        code_op(fields[f"h{t}_op"][j] - 1),
+                        code_ret(fields[f"h{t}_ret"][j] - 1),
+                    )
+                )
+            fl = fields[f"h{t}_fl"]
+            if fl:
+                prereqs = {}
+                for pi, p in enumerate(self.peers[t]):
+                    raw = fields[f"h{t}_flpre"][pi]
+                    if raw:
+                        prereqs[self.thread_ids[p]] = raw - 2
+                tester.in_flight_by_thread[tid] = (prereqs, code_op(fl - 1))
+        return tester

@@ -447,8 +447,22 @@ class ShardedXlaChecker(Checker):
                     disc_found, disc_fp, i, viol, fhi, flo
                 )
 
-            # 2. local action-grid expansion.
-            nxt, valid = jax.vmap(model.packed_step)(frontier)  # [Fl,A,W],[Fl,A]
+            # 2. local action-grid expansion. An optional third output is
+            #    the per-action codec-overflow mask (see xla.py superstep
+            #    step 2): psum'd across shards and surfaced loudly.
+            stepped = jax.vmap(model.packed_step)(frontier)  # [Fl,A,W],[Fl,A]
+            if len(stepped) == 3:
+                nxt, valid, step_ovf = stepped
+                codec_ovf = (
+                    jax.lax.pmax(
+                        jnp.any(step_ovf & f_valid[:, None]).astype(jnp.uint32),
+                        "shards",
+                    )
+                    > 0
+                )
+            else:
+                nxt, valid = stepped
+                codec_ovf = jnp.bool_(False)
             valid = valid & f_valid[:, None]
             step_states = jax.lax.psum(jnp.sum(valid, dtype=jnp.int32), "shards")
 
@@ -547,6 +561,7 @@ class ShardedXlaChecker(Checker):
                 table_ovf,
                 frontier_ovf,
                 route_ovf,
+                codec_ovf,
             )
 
         spec_rows = P("shards", None)
@@ -567,6 +582,7 @@ class ShardedXlaChecker(Checker):
                 spec_plane,
                 spec_plane,
                 (spec_plane,) * 4,
+                spec_rep,
                 spec_rep,
                 spec_rep,
                 spec_rep,
@@ -672,7 +688,14 @@ class ShardedXlaChecker(Checker):
                 self._disc_fp,
             )
             (nf, ne, ncounts, table, dfound, dfp, d_states, d_unique,
-             t_ovf, f_ovf, r_ovf) = out
+             t_ovf, f_ovf, r_ovf, c_ovf) = out
+            if bool(np.asarray(c_ovf)):
+                raise RuntimeError(
+                    f"{type(self._model).__name__}: packed-codec capacity "
+                    "overflow — a reachable successor does not fit the "
+                    "model's declared field widths/slot counts (see "
+                    "stateright_tpu.packing)."
+                )
             if bool(np.asarray(t_ovf)):
                 self._grow_table()
                 continue
