@@ -101,7 +101,7 @@ class PackedPingPong(Model):
         return 2 * env.msg.value + 1
 
     def _code_env(self, code: int) -> Envelope:
-        v, is_pong = divmod(code, 2)[0], code % 2
+        v, is_pong = divmod(code, 2)
         if is_pong:
             return Envelope(Id(1), Id(0), Pong(v))
         return Envelope(Id(0), Id(1), Ping(v))

@@ -67,6 +67,305 @@ def single_copy_register_model(
     )
 
 
+class PackedSingleCopyRegister:
+    """The single-copy register on the device engine (``spawn_xla``) — the
+    first packed model carrying a **consistency tester** in its state
+    (SURVEY §7 M4 variant (a)).
+
+    Everything is declared through :mod:`stateright_tpu.packing`:
+
+    - per-server register values and per-client script positions are plain
+      layout fields;
+    - the non-duplicating multiset network packs as per-envelope counts
+      over the *closed* envelope universe of this protocol (each client
+      performs one Put then one Get with statically known request ids and
+      targets, register.rs:94-260, so the universe is tiny);
+    - the ``LinearizabilityTester`` history packs exactly via
+      :class:`~stateright_tpu.packing.BoundedHistory` (2 ops/client).
+
+    The ``linearizable`` property is **host-verified**: the device runs a
+    conservative predicate (a history with no completed read — and no
+    protocol poison — is always linearizable for a register: completed
+    writes admit any real-time-respecting order), and the engine re-checks
+    flagged candidates with the exact backtracking serializer
+    (linearizability.rs:197-284) on the host before recording the
+    counterexample. With one server the model reaches full coverage (93
+    unique states, single-copy-register.rs:110); with two servers the
+    stale-read counterexample is confirmed on host
+    (single-copy-register.rs:136).
+    """
+
+    host_verified_properties = frozenset({"linearizable"})
+
+    def __init__(self, client_count: int = 2, server_count: int = 1):
+        from ..actor.network import Envelope
+        from ..packing import BoundedHistory, LayoutBuilder, OverflowError32
+        from ..semantics.register import Read, ReadOk, Write, WriteOk
+
+        self._inner = single_copy_register_model(client_count, server_count)
+        S, C = server_count, client_count
+        self.S, self.C = S, C
+        self.values = [None] + [chr(ord("A") + k) for k in range(C)]
+        V = len(self.values)
+        self.V = V
+
+        # Closed envelope universe: per client k (abs id i = S+k), block of
+        # 3 + V codes: Put, PutOk, Get, GetOk(value) per value.
+        self._B = 3 + V
+        envs = []
+        for k in range(C):
+            i = S + k
+            envs.append(Envelope(Id(i), Id(i % S), reg.Put(1 * i, self.values[1 + k])))
+            envs.append(Envelope(Id(i % S), Id(i), reg.PutOk(1 * i)))
+            envs.append(Envelope(Id(i), Id((i + 1) % S), reg.Get(2 * i)))
+            for v in self.values:
+                envs.append(Envelope(Id((i + 1) % S), Id(i), reg.GetOk(2 * i, v)))
+        self._envs = envs
+        self._env_code = {env: c for c, env in enumerate(envs)}
+        U = len(envs)
+        self._U = U
+
+        value_bits = max((V - 1).bit_length(), 1)
+        op_ret_bits = max(V.bit_length(), 2)
+        b = (
+            LayoutBuilder()
+            .array("srv", S, value_bits)
+            .array("cl_await", C, 2)
+            .array("cl_ops", C, 2)
+            .array("net", U, 2)
+        )
+        self._hist = BoundedHistory(
+            b,
+            thread_ids=[Id(S + k) for k in range(C)],
+            max_ops=2,
+            op_bits=op_ret_bits,
+            ret_bits=op_ret_bits,
+        )
+        self._layout = b.finish()
+        self._hist.bind(self._layout)
+        self.state_words = self._layout.words
+        self.max_actions = U
+
+        # History op/ret codes over the closed value universe.
+        def op_code(op):
+            if isinstance(op, Read):
+                return 0
+            return 1 + self.values.index(op.value)
+
+        def code_op(c):
+            return Read() if c == 0 else Write(self.values[c - 1])
+
+        def ret_code(ret):
+            if isinstance(ret, WriteOk):
+                return 0
+            return 1 + self.values.index(ret.value)
+
+        def code_ret(c):
+            return WriteOk() if c == 0 else ReadOk(self.values[c - 1])
+
+        self._op_code, self._code_op = op_code, code_op
+        self._ret_code, self._code_ret = ret_code, code_ret
+        self._OverflowError32 = OverflowError32
+
+    # --- object-level Model API: delegate to the ActorModel ----------------
+
+    def init_states(self):
+        return self._inner.init_states()
+
+    def actions(self, state, actions):
+        self._inner.actions(state, actions)
+
+    def next_state(self, state, action):
+        return self._inner.next_state(state, action)
+
+    def properties(self):
+        return self._inner.properties()
+
+    def within_boundary(self, state):
+        return self._inner.within_boundary(state)
+
+    def format_action(self, action):
+        return self._inner.format_action(action)
+
+    def checker(self):
+        from ..checker.builder import CheckerBuilder
+
+        return CheckerBuilder(self)
+
+    # --- codec -------------------------------------------------------------
+
+    def pack(self, state) -> "np.ndarray":
+        import numpy as np
+
+        S, C = self.S, self.C
+        srv = [self.values.index(state.actor_states[s]) for s in range(S)]
+        cl_await, cl_ops = [], []
+        for k in range(C):
+            i = S + k
+            cs = state.actor_states[S + k]
+            if cs.awaiting is None:
+                cl_await.append(0)
+            elif cs.awaiting == 1 * i:
+                cl_await.append(1)
+            elif cs.awaiting == 2 * i:
+                cl_await.append(2)
+            else:  # pragma: no cover - unreachable by construction
+                raise self._OverflowError32(f"unexpected request id {cs.awaiting}")
+            cl_ops.append(cs.op_count)
+        net = [0] * self._U
+        for env, count in state.network.counts.items():
+            code = self._env_code.get(env)
+            if code is None:
+                raise self._OverflowError32(f"envelope outside universe: {env!r}")
+            if count > 3:
+                raise self._OverflowError32(f"envelope count {count} > 3: {env!r}")
+            net[code] = count
+        fields = dict(srv=srv, cl_await=cl_await, cl_ops=cl_ops, net=net)
+        fields.update(self._hist.from_tester(state.history, self._op_code, self._ret_code))
+        return self._layout.pack(**fields)
+
+    def unpack(self, words):
+        from ..actor.model_state import ActorModelState
+        from ..actor.network import UnorderedNonDuplicatingNetwork
+        from ..actor.timers import Timers
+        from ..semantics import LinearizabilityTester
+        from ..semantics.register import Register
+
+        f = self._layout.unpack(words)
+        S, C = self.S, self.C
+        actor_states = [self.values[code] for code in f["srv"]]
+        for k in range(C):
+            i = S + k
+            awaiting = {0: None, 1: 1 * i, 2: 2 * i}[f["cl_await"][k]]
+            actor_states.append(
+                reg.ClientState(awaiting=awaiting, op_count=f["cl_ops"][k])
+            )
+        counts = {
+            self._envs[code]: count for code, count in enumerate(f["net"]) if count
+        }
+        history = self._hist.to_tester(
+            f,
+            lambda: LinearizabilityTester(Register(None)),
+            self._code_op,
+            self._code_ret,
+        )
+        return ActorModelState(
+            actor_states=tuple(actor_states),
+            network=UnorderedNonDuplicatingNetwork(counts),
+            timers_set=tuple(Timers() for _ in range(S + C)),
+            history=history,
+        )
+
+    # --- device kernels -----------------------------------------------------
+
+    def packed_init(self):
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self._inner.init_states()])
+
+    def _net_dec(self, words, code):
+        L = self._layout
+        return L.set(words, "net", L.get(words, "net", code) - 1, code)
+
+    def _net_inc(self, words, code):
+        """Increment an envelope count; returns (words', overflow)."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        cnt = L.get(words, "net", code)
+        return L.set(words, "net", cnt + 1, code), cnt == jnp.uint32(3)
+
+    def packed_step(self, words):
+        """Full action fan-out: deliver each universe envelope. No-op
+        deliveries (script mismatches, model.rs:286-289) are masked
+        invalid; capacity overflows are reported on the third output."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        S, C, V, B = self.S, self.C, self.V, self._B
+        u32 = jnp.uint32
+
+        nxt, valid, ovf = [], [], []
+        for k in range(C):
+            i = S + k
+            base = k * B
+            deliverable = lambda code: L.get(words, "net", code) > 0  # noqa: E731
+
+            # Put -> server i%S: store the value, reply PutOk.
+            code = base + 0
+            w = self._net_dec(words, code)
+            w = L.set(w, "srv", 1 + k, i % S)
+            w, o = self._net_inc(w, base + 1)
+            nxt.append(w)
+            valid.append(deliverable(code))
+            ovf.append(o)
+
+            # PutOk -> client: record WriteOk return, invoke Read, send Get.
+            code = base + 1
+            eligible = L.get(words, "cl_await", k) == u32(1)
+            w = self._net_dec(words, code)
+            w = L.set(w, "cl_await", 2, k)
+            w = L.set(w, "cl_ops", 2, k)
+            w, o1 = self._hist.on_return(w, k, u32(0))  # WriteOk
+            w = self._hist.on_invoke(w, k, u32(0))  # Read
+            w, o2 = self._net_inc(w, base + 2)
+            nxt.append(w)
+            valid.append(deliverable(code) & eligible)
+            ovf.append(o1 | o2)
+
+            # Get -> server (i+1)%S: reply GetOk with the current value
+            # (a traced index into the GetOk block of the universe).
+            code = base + 2
+            srv_val = L.get(words, "srv", (i + 1) % S)
+            w = self._net_dec(words, code)
+            w, o = self._net_inc(w, base + 3 + srv_val.astype(jnp.int32))
+            nxt.append(w)
+            valid.append(deliverable(code))
+            ovf.append(o)
+
+            # GetOk(value) -> client: record ReadOk return; script complete.
+            for vi in range(V):
+                code = base + 3 + vi
+                eligible = L.get(words, "cl_await", k) == u32(2)
+                w = self._net_dec(words, code)
+                w = L.set(w, "cl_await", 0, k)
+                w = L.set(w, "cl_ops", 3, k)
+                w, o = self._hist.on_return(w, k, u32(1 + vi))  # ReadOk(value)
+                nxt.append(w)
+                valid.append(deliverable(code) & eligible)
+                ovf.append(o)
+
+        valid = jnp.stack(valid)
+        return jnp.stack(nxt), valid, jnp.stack(ovf) & valid
+
+    def packed_properties(self, words):
+        """[conservative linearizable, value chosen] — order of
+        ``properties()``. The first is the host-verified conservative
+        predicate: True (= certainly linearizable) iff the history is
+        unpoisoned and contains no completed read; completed-write-only
+        histories always admit a legal serialization for a register."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        u32 = jnp.uint32
+        no_read = jnp.bool_(True)
+        for k in range(self.C):
+            for j in range(2):
+                no_read = no_read & (L.get(words, f"h{k}_ret", j) < u32(2))
+        lin_conservative = (L.get(words, "h_valid") != 0) & no_read
+
+        chosen = jnp.bool_(False)
+        for k in range(self.C):
+            for vi in range(1, self.V):  # real (written) values only
+                chosen = chosen | (L.get(words, "net", k * self._B + 3 + vi) > 0)
+        return jnp.stack([lin_conservative, chosen])
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
 def main(argv=None) -> None:
     """CLI mirroring single-copy-register.rs:139-233:
     ``check``/``explore``/``spawn`` subcommands."""

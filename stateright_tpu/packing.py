@@ -313,6 +313,12 @@ class SlotMultiset:
             raise OverflowError32(
                 f"{len(code_counts)} distinct envelopes > {self.k} slots"
             )
+        codes = [c for c, _n in code_counts]
+        if len(set(codes)) != len(codes):
+            raise OverflowError32(
+                "duplicate envelope codes — merge counts before packing "
+                "(duplicates would break canonical slot words)"
+            )
         slots = []
         for code, count in code_counts:
             if not 0 <= code < (1 << self.code_bits):
@@ -508,9 +514,12 @@ class BoundedHistory:
 
         enabled = jnp.asarray(enabled)
         L = self.layout
+        # A poisoned history is frozen: the tester raises HistoryError on
+        # every later call and record_* leave it unchanged.
+        valid = L.get(words, "h_valid")
+        enabled = enabled & (valid != 0)
         cur = L.get(words, f"h{t}_fl")
         misuse = enabled & (cur != 0)
-        valid = L.get(words, "h_valid")
         words = L.set(
             words, "h_valid", jnp.where(misuse, jnp.uint32(0), valid)
         )
@@ -539,12 +548,14 @@ class BoundedHistory:
 
         enabled = jnp.asarray(enabled)
         L = self.layout
+        # Frozen once poisoned (see on_invoke).
+        valid = L.get(words, "h_valid")
+        enabled = enabled & (valid != 0)
         n = L.get(words, f"h{t}_n").astype(jnp.int32)
         fl = L.get(words, f"h{t}_fl")
         slot = jnp.minimum(n, self.max_ops - 1)
         misuse = enabled & (fl == 0)
         overflow = enabled & (fl != 0) & (n >= self.max_ops)
-        valid = L.get(words, "h_valid")
         words = L.set(words, "h_valid", jnp.where(misuse, jnp.uint32(0), valid))
         do = enabled & (fl != 0) & (n < self.max_ops)
         cur_op = L.get(words, f"h{t}_op", slot)

@@ -80,6 +80,12 @@ class ShardedXlaChecker(Checker):
 
         model = builder._model
         _require_packed(model)
+        if getattr(model, "host_verified_properties", ()):
+            raise NotImplementedError(
+                "host-verified properties are not yet supported on the "
+                "sharded engine; use single-chip spawn_xla() for models "
+                "with consistency-tester properties."
+            )
         self._model = model
         self._mesh = mesh
         self._D = mesh.devices.size

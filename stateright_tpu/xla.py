@@ -47,6 +47,17 @@ kernels over bit-packed uint32 state words:
   packed words (used for witness reconstruction and the Explorer).
 - ``packed_representative(words[W]) -> words[W]`` — optional, for symmetry
   reduction: the device form of ``Representative`` (representative.rs:65).
+- ``host_verified_properties: frozenset[str]`` — optional. Properties whose
+  exact condition cannot run on device (the linearizability testers'
+  backtracking search, linearizability.rs:197-284). For these the
+  ``packed_properties`` entry is a *conservative* predicate — it may be
+  False (a candidate violation for ``always`` / candidate example for
+  ``sometimes``... the polarity of "suspicious") only when the exact
+  answer might disagree with the safe default, and must be exact in the
+  other direction. The engine compacts candidate states into a small
+  buffer per super-step and re-evaluates them on the host with the
+  property's exact object-level condition (memoized serializer) before
+  recording a discovery — SURVEY §7 M4 variant (a).
 """
 
 from __future__ import annotations
@@ -85,6 +96,7 @@ class XlaChecker(Checker):
         frontier_capacity: int = 1 << 15,
         table_capacity: int = 1 << 20,
         max_probes: int = 32,
+        host_verified_cap: int = 128,
         checkpoint: Optional[str] = None,
     ):
         import jax
@@ -116,6 +128,24 @@ class XlaChecker(Checker):
         self._W = model.state_words
         self._A = model.max_actions
         self._P = len(self._properties)
+        # Host-verified properties: device flags candidates, host confirms
+        # with the exact object-level condition (see module docstring).
+        hv_names = frozenset(getattr(model, "host_verified_properties", ()))
+        unknown = hv_names - {p.name for p in self._properties}
+        if unknown:
+            raise ValueError(f"host_verified_properties not in properties(): {unknown}")
+        self._hv_idx = [
+            i for i, p in enumerate(self._properties) if p.name in hv_names
+        ]
+        for i in self._hv_idx:
+            if self._properties[i].expectation == Expectation.EVENTUALLY:
+                raise ValueError(
+                    "host-verified eventually-properties are not supported"
+                )
+        # Candidate rows per super-step per host-verified property;
+        # spawn_xla(host_verified_cap=...) raises it for models whose
+        # conservative predicates flag wide swaths of the frontier.
+        self._hv_cap = host_verified_cap
 
         # --- device state ------------------------------------------------
         import jax.numpy as jnp
@@ -286,6 +316,8 @@ class XlaChecker(Checker):
         symmetry = self._symmetry
         A, W = self._A, self._W
         max_probes = self._max_probes
+        hv_idx = list(self._hv_idx)
+        hv_cap = self._hv_cap
 
         def dedup_words(words):
             return model.packed_representative(words) if symmetry else words
@@ -297,6 +329,9 @@ class XlaChecker(Checker):
 
             # 1. fused property evaluation over the frontier.
             props = jax.vmap(model.packed_properties)(frontier)  # [F, P]
+            hv_words_out = []
+            hv_fp_out = []
+            hv_count_out = []
             for i, expectation in prop_specs:
                 if expectation == Expectation.EVENTUALLY:
                     bit = jnp.uint32(1 << ebit_of_prop[i])
@@ -307,12 +342,41 @@ class XlaChecker(Checker):
                     viol = ~props[:, i] & f_valid
                 else:  # SOMETIMES: an example is a "discovery" too
                     viol = props[:, i] & f_valid
+                if i in hv_idx:
+                    # Candidates only — the host confirms with the exact
+                    # condition before anything becomes a discovery.
+                    pos = jnp.cumsum(viol.astype(jnp.int32)) - 1
+                    cidx = jnp.where(viol & (pos < hv_cap), pos, hv_cap)
+                    cw = (
+                        jnp.zeros((hv_cap, W), jnp.uint32)
+                        .at[cidx]
+                        .set(frontier, mode="drop")
+                    )
+                    cf = (
+                        jnp.zeros((hv_cap, 2), jnp.uint32)
+                        .at[cidx, 0]
+                        .set(fhi, mode="drop")
+                        .at[cidx, 1]
+                        .set(flo, mode="drop")
+                    )
+                    hv_words_out.append(cw)
+                    hv_fp_out.append(cf)
+                    hv_count_out.append(jnp.sum(viol, dtype=jnp.int32))
+                    continue
                 has = jnp.any(viol)
                 first = jnp.argmax(viol)
                 take = has & ~disc_found[i]
                 disc_fp = disc_fp.at[i, 0].set(jnp.where(take, fhi[first], disc_fp[i, 0]))
                 disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
                 disc_found = disc_found.at[i].set(disc_found[i] | has)
+            if hv_idx:
+                hv_words = jnp.stack(hv_words_out)
+                hv_fps = jnp.stack(hv_fp_out)
+                hv_counts = jnp.stack(hv_count_out)
+            else:
+                hv_words = jnp.zeros((0, hv_cap, W), jnp.uint32)
+                hv_fps = jnp.zeros((0, hv_cap, 2), jnp.uint32)
+                hv_counts = jnp.zeros((0,), jnp.int32)
 
             # 2. full action-grid expansion. A model may return a third
             #    per-action overflow mask: "this successor exists but does
@@ -379,6 +443,9 @@ class XlaChecker(Checker):
                 table_overflow,
                 frontier_overflow,
                 codec_overflow,
+                hv_words,
+                hv_fps,
+                hv_counts,
             )
 
         return jax.jit(superstep)
@@ -496,6 +563,9 @@ class XlaChecker(Checker):
                 t_ovf,
                 f_ovf,
                 c_ovf,
+                hv_words,
+                hv_fps,
+                hv_counts,
             ) = out
             if bool(c_ovf):
                 raise RuntimeError(
@@ -527,6 +597,8 @@ class XlaChecker(Checker):
         self._state_count += int(d_states)
         self._unique_count += int(d_unique)
         self._depth += 1
+        if self._hv_idx:
+            self._confirm_hv_candidates(hv_words, hv_fps, hv_counts)
         # Pin first-found witnesses by name.
         found = np.asarray(self._disc_found)
         fps = np.asarray(self._disc_fp)
@@ -538,6 +610,42 @@ class XlaChecker(Checker):
             and self._state_count >= self._target_state_count
         ):
             self._target_reached = True
+
+    def _confirm_hv_candidates(self, hv_words, hv_fps, hv_counts) -> None:
+        """Exact host-side re-check of device-flagged candidate states for
+        host-verified properties (SURVEY §7 M4a): the first candidate (in
+        frontier order) whose exact condition confirms the violation/example
+        becomes the discovery. Conditions like the linearizability testers
+        memoize per distinct history, so repeat candidates are cheap."""
+        counts = np.asarray(hv_counts)
+        words = fps = None
+        for j, i in enumerate(self._hv_idx):
+            prop = self._properties[i]
+            if prop.name in self._found_names:
+                continue
+            n = int(counts[j])
+            if n == 0:
+                continue
+            if words is None:
+                words = np.asarray(hv_words)
+                fps = np.asarray(hv_fps)
+            confirmed = False
+            for r in range(min(n, self._hv_cap)):
+                state = self._model.unpack(words[j, r])
+                holds = bool(prop.condition(self._model, state))
+                viol = (not holds) if prop.expectation == Expectation.ALWAYS else holds
+                if viol:
+                    fp64 = (int(fps[j, r, 0]) << 32) | int(fps[j, r, 1])
+                    self._found_names[prop.name] = fp64
+                    confirmed = True
+                    break
+            if not confirmed and n > self._hv_cap:
+                raise RuntimeError(
+                    f"{n} candidate states for host-verified property "
+                    f"{prop.name!r} in one super-step, none of the first "
+                    f"{self._hv_cap} confirmed — tighten the conservative "
+                    "device predicate or raise the candidate cap."
+                )
 
     def _visit_frontier(self) -> None:
         """Applies the visitor to every frontier state's path (the XLA

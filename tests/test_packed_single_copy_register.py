@@ -1,0 +1,77 @@
+"""Packed single-copy register on the device engine: the first packed model
+with a LinearizabilityTester riding in its state (SURVEY §7 M4a).
+
+Oracles from the reference's own tests (single-copy-register.rs:110,136):
+93 unique states at 2 clients / 1 server (full coverage, linearizable);
+with 2 servers the stale-read counterexample must be confirmed — on the
+device engine via the host-verified property machinery (conservative device
+predicate + exact backtracking serializer on candidates).
+"""
+
+import numpy as np
+
+from stateright_tpu.models.single_copy_register import (
+    PackedSingleCopyRegister,
+    single_copy_register_model,
+)
+
+
+def test_codec_round_trips_every_reachable_state():
+    """pack/unpack must be a bijection over the reachable space — the
+    foundation for fingerprint agreement between engines."""
+    from stateright_tpu.checker.visitor import StateRecorder
+
+    model = PackedSingleCopyRegister(2, 1)
+    rec, get_states = StateRecorder.new_with_accessor()
+    single_copy_register_model(2, 1).checker().visitor(rec).spawn_bfs().join()
+    states = get_states()
+    assert len(states) >= 93
+    seen_words = set()
+    for s in states:
+        words = model.pack(s)
+        rebuilt = model.unpack(words)
+        assert rebuilt == s, f"codec round-trip mismatch for {s!r}"
+        np.testing.assert_array_equal(model.pack(rebuilt), words)
+        seen_words.add(tuple(int(w) for w in words))
+    # distinct states -> distinct words (injective)
+    assert len(seen_words) == len(set(states))
+
+
+def test_xla_one_server_matches_oracle_full_coverage():
+    model = PackedSingleCopyRegister(2, 1)
+    xc = model.checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 12
+    ).join()
+    bc = single_copy_register_model(2, 1).checker().spawn_bfs().join()
+    assert bc.unique_state_count() == 93  # single-copy-register.rs:110
+    assert xc.unique_state_count() == 93
+    # Linearizable with one copy: no counterexample; the reachability
+    # example exists and its witness path replays.
+    xc.assert_properties()
+    path = xc.discoveries()["value chosen"]
+    final = path.last_state()
+    assert any(
+        getattr(env.msg, "value", None) is not None
+        and type(env.msg).__name__ == "GetOk"
+        for env in final.network.iter_deliverable()
+    )
+
+
+def test_xla_two_servers_finds_linearizability_counterexample():
+    model = PackedSingleCopyRegister(2, 2)
+    xc = model.checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 12
+    ).join()
+    discoveries = xc.discoveries()
+    assert "linearizable" in discoveries  # the always-property fails
+    # The witness really is a non-linearizable history per the exact
+    # backtracking serializer (not just the conservative device flag).
+    final = discoveries["linearizable"].last_state()
+    assert final.history.serialized_history() is None
+    # Level-synchronous BFS finds a counterexample at the same depth as
+    # the state-at-a-time oracle (both explore in BFS level order; the
+    # reference's 20-state early-stop count is a mid-level artifact its
+    # own BFS/DFS also disagree on).
+    oracle = single_copy_register_model(2, 2).checker().spawn_bfs().join()
+    assert "linearizable" in oracle.discoveries()
+    assert len(discoveries["linearizable"]) == len(oracle.discoveries()["linearizable"])
