@@ -99,6 +99,35 @@ def value_chosen_condition(_model=None, state=None) -> bool:
     return False
 
 
+def history_codecs(values):
+    """Closed-universe op/ret codes for register histories over ``values``
+    (a list whose first element is the unwritten ``None``): used by packed
+    models to run :class:`~stateright_tpu.packing.BoundedHistory` over a
+    ``LinearizabilityTester`` of the ``Register`` spec.
+
+    Returns ``(op_code, code_op, ret_code, code_ret)``:
+    ``Read() = 0``, ``Write(v) = 1 + values.index(v)``;
+    ``WriteOk() = 0``, ``ReadOk(v) = 1 + values.index(v)``.
+    """
+    def op_code(op):
+        if isinstance(op, RegisterRead):
+            return 0
+        return 1 + values.index(op.value)
+
+    def code_op(c):
+        return RegisterRead() if c == 0 else RegisterWrite(values[c - 1])
+
+    def ret_code(ret):
+        if isinstance(ret, RegisterWriteOk):
+            return 0
+        return 1 + values.index(ret.value)
+
+    def code_ret(c):
+        return RegisterWriteOk() if c == 0 else RegisterReadOk(values[c - 1])
+
+    return op_code, code_op, ret_code, code_ret
+
+
 ClientState = variant("ClientState", ["awaiting", "op_count"])
 
 
