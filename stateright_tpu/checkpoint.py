@@ -28,13 +28,31 @@ from typing import Any, Dict
 
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: fingerprints moved to the Zobrist-form hash (ops/fphash.py) and the
+# metadata gained the model-config digest; v1 checkpoints persist fingerprints
+# under the old hash and must be rejected, not silently resumed.
+FORMAT_VERSION = 2
 
 
 def _normalize(path: str) -> str:
     """np.savez appends '.npz' when absent; normalize both ends so any path
     round-trips."""
     return path if path.endswith(".npz") else path + ".npz"
+
+
+def model_digest(model) -> str:
+    """A digest of the model's *configuration*, not just its geometry: the
+    packed initial states pin every config knob that shapes the transition
+    system (field layouts, history presence, actor counts), so a checkpoint
+    cannot silently resume into a differently-configured instance of the
+    same model class."""
+    import hashlib
+
+    rows = np.ascontiguousarray(np.asarray(model.packed_init(), dtype=np.uint32))
+    h = hashlib.sha256()
+    h.update(repr((rows.shape, model.state_words, model.max_actions)).encode())
+    h.update(rows.tobytes())
+    return h.hexdigest()[:16]
 
 
 def save_checkpoint(checker, path: str) -> None:
@@ -53,6 +71,7 @@ def save_checkpoint(checker, path: str) -> None:
     meta = {
         "format_version": FORMAT_VERSION,
         "model": type(checker._model).__name__,
+        "init_digest": model_digest(checker._model),
         "state_words": checker._W,
         "max_actions": checker._A,
         "property_names": checker._prop_names,
@@ -128,6 +147,12 @@ def validate_model(meta: Dict[str, Any], model, prop_names) -> None:
         )
     if meta["max_actions"] != model.max_actions:
         problems.append(f"max_actions {meta['max_actions']} != {model.max_actions}")
+    digest = model_digest(model)
+    if meta["init_digest"] != digest:
+        problems.append(
+            f"model config digest {meta['init_digest']} != {digest} "
+            "(same class, different configuration)"
+        )
     if meta["property_names"] != list(prop_names):
         problems.append(
             f"properties {meta['property_names']} != {list(prop_names)}"

@@ -23,6 +23,7 @@ from typing import Optional
 from ..actor import Actor, ActorModel, Id, Network, Out, StateRef
 from ..actor import register as reg
 from ..core import Expectation
+from ..packing import PackedModelAdapter, bits_for
 from ..semantics import LinearizabilityTester
 from ..semantics.register import Register
 
@@ -67,7 +68,7 @@ def single_copy_register_model(
     )
 
 
-class PackedSingleCopyRegister:
+class PackedSingleCopyRegister(PackedModelAdapter):
     """The single-copy register on the device engine (``spawn_xla``) — the
     first packed model carrying a **consistency tester** in its state
     (SURVEY §7 M4 variant (a)).
@@ -125,7 +126,7 @@ class PackedSingleCopyRegister:
         U = len(envs)
         self._U = U
 
-        value_bits = max((V - 1).bit_length(), 1)
+        value_bits = bits_for(V - 1)
         op_ret_bits = max(V.bit_length(), 2)
         b = (
             LayoutBuilder()
@@ -166,31 +167,6 @@ class PackedSingleCopyRegister:
         self._op_code, self._code_op = op_code, code_op
         self._ret_code, self._code_ret = ret_code, code_ret
         self._OverflowError32 = OverflowError32
-
-    # --- object-level Model API: delegate to the ActorModel ----------------
-
-    def init_states(self):
-        return self._inner.init_states()
-
-    def actions(self, state, actions):
-        self._inner.actions(state, actions)
-
-    def next_state(self, state, action):
-        return self._inner.next_state(state, action)
-
-    def properties(self):
-        return self._inner.properties()
-
-    def within_boundary(self, state):
-        return self._inner.within_boundary(state)
-
-    def format_action(self, action):
-        return self._inner.format_action(action)
-
-    def checker(self):
-        from ..checker.builder import CheckerBuilder
-
-        return CheckerBuilder(self)
 
     # --- codec -------------------------------------------------------------
 
@@ -347,23 +323,14 @@ class PackedSingleCopyRegister:
         import jax.numpy as jnp
 
         L = self._layout
-        u32 = jnp.uint32
-        no_read = jnp.bool_(True)
-        for k in range(self.C):
-            for j in range(2):
-                no_read = no_read & (L.get(words, f"h{k}_ret", j) < u32(2))
-        lin_conservative = (L.get(words, "h_valid") != 0) & no_read
+        # ReadOk ret codes are >= 1 under this model's coding (WriteOk = 0).
+        lin_conservative = self._hist.valid_with_no_return_geq(words, 1)
 
         chosen = jnp.bool_(False)
         for k in range(self.C):
             for vi in range(1, self.V):  # real (written) values only
                 chosen = chosen | (L.get(words, "net", k * self._B + 3 + vi) > 0)
         return jnp.stack([lin_conservative, chosen])
-
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        return getattr(self._inner, name)
 
 
 def main(argv=None) -> None:

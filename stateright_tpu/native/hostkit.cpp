@@ -21,7 +21,9 @@
 extern "C" {
 
 // ---------------------------------------------------------------------------
-// Two-lane murmur fingerprint, the exact mirror of ops/fphash.py.
+// Two-lane Zobrist-form fingerprint, the exact mirror of ops/fphash.py:
+// per-word position-keyed fmix32 digests, XOR-folded across the width, one
+// final avalanche over the seeded fold.
 // ---------------------------------------------------------------------------
 
 static inline uint32_t fmix32(uint32_t h) {
@@ -37,15 +39,17 @@ static inline uint32_t fmix32(uint32_t h) {
 void fingerprint_words(const uint32_t* words, int64_t n, int64_t w,
                        uint32_t* out_hi, uint32_t* out_lo) {
     for (int64_t r = 0; r < n; ++r) {
-        uint32_t hi = 0x9E3779B9u;
-        uint32_t lo = 0x517CC1B7u;
+        uint32_t fold_hi = 0;
+        uint32_t fold_lo = 0;
         const uint32_t* row = words + r * w;
         for (int64_t i = 0; i < w; ++i) {
             uint32_t word = row[i];
-            hi = fmix32(hi ^ (word * 0x2545F491u + (uint32_t)(i + 1)));
-            lo = fmix32(lo ^ (word * 0x85157AF5u +
-                              (uint32_t)(0x61C88647u * (uint32_t)(i + 1))));
+            uint32_t pos = (uint32_t)(i + 1);
+            fold_hi ^= fmix32(word * 0x2545F491u + 0x9E3779B9u * pos);
+            fold_lo ^= fmix32(word * 0x85157AF5u + 0x61C88647u * pos);
         }
+        uint32_t hi = fmix32(fold_hi ^ 0x9E3779B9u);
+        uint32_t lo = fmix32(fold_lo ^ 0x517CC1B7u);
         if (hi == 0 && lo == 0) lo = 1;  // reserve EMPTY sentinel
         out_hi[r] = hi;
         out_lo[r] = lo;

@@ -5,11 +5,24 @@ The reference fingerprints states with a fixed-key 64-bit hash
 the contract because witness paths are reconstructed from fingerprints later.
 
 TPUs have no native 64-bit integer path worth using for this, so the device
-fingerprint is two independent 32-bit murmur3-style lanes (fmix32 finalizer
-constants, public domain) over the state words.  The same function runs under
-numpy on the host — ``stateright_tpu.xla`` uses the host flavor during path
-reconstruction, so host/device agreement is load-bearing and covered by
-differential tests.
+fingerprint is two independent 32-bit lanes in **Zobrist form** (the classic
+state-hash structure in explicit-state model checkers): each word is mixed
+with a position key through a murmur3 fmix32 finalizer (public-domain
+constants), the per-word digests are XOR-folded across the width, and one
+final fmix32 avalanches the fold.  Two reasons for this shape over a
+sequential per-word chain:
+
+- it vectorizes across the word axis (the chain forces ~8*W dependent scalar
+  ops per lane on the VPU; the fold is elementwise work plus a log-free XOR
+  reduction), and
+- XLA:CPU's LLVM pipeline *hangs* (minutes to forever, superlinearly in W)
+  optimizing kernels where a W-deep mul/shift chain is fused into wide
+  consumers — observed on packed-Paxos supersteps at W=25, threshold ~W=10.
+
+The same function runs under numpy on the host — ``stateright_tpu.xla`` uses
+the host flavor during path reconstruction — and in C++
+(``native/hostkit.cpp``), so three-way agreement is load-bearing and covered
+by differential tests.
 
 The pair (0, 0) is reserved as the hash-set EMPTY sentinel and is remapped.
 """
@@ -48,18 +61,28 @@ def fingerprint_words(words, xp):
     import numpy as _np
 
     # numpy warns on (intended, wrapping) uint32 overflow; jnp does not.
-    ctx = _np.errstate(over="ignore") if xp is _np else contextlib.nullcontext()
+    under_jax = xp is not _np
+    ctx = contextlib.nullcontext() if under_jax else _np.errstate(over="ignore")
     with ctx:
         u = xp.uint32
         w_count = words.shape[-1]
-        hi = xp.full(words.shape[:-1], _SEED_HI, dtype=xp.uint32)
-        lo = xp.full(words.shape[:-1], _SEED_LO, dtype=xp.uint32)
-        for i in range(w_count):
-            w = words[..., i].astype(xp.uint32)
-            hi = _fmix32(hi ^ (w * u(_WORD_MIX_HI) + u(i + 1)), xp)
-            lo = _fmix32(
-                lo ^ (w * u(_WORD_MIX_LO) + u(0x61C88647 * (i + 1) & 0xFFFFFFFF)), xp
-            )
+        idx = _np.arange(1, w_count + 1, dtype=_np.uint64)
+        pos_hi = xp.asarray((0x9E3779B9 * idx & 0xFFFFFFFF).astype(_np.uint32))
+        pos_lo = xp.asarray((0x61C88647 * idx & 0xFFFFFFFF).astype(_np.uint32))
+        words = words.astype(xp.uint32)
+        # Per-word position-keyed digests (elementwise over the width)...
+        m_hi = _fmix32(words * u(_WORD_MIX_HI) + pos_hi, xp)
+        m_lo = _fmix32(words * u(_WORD_MIX_LO) + pos_lo, xp)
+        # ...XOR-folded (order-free, so swapping unequal positions still
+        # changes the fold through the position keys)...
+        fold_hi = m_hi[..., 0]
+        fold_lo = m_lo[..., 0]
+        for i in range(1, w_count):
+            fold_hi = fold_hi ^ m_hi[..., i]
+            fold_lo = fold_lo ^ m_lo[..., i]
+        # ...then one avalanche over the seeded fold.
+        hi = _fmix32(fold_hi ^ u(_SEED_HI), xp)
+        lo = _fmix32(fold_lo ^ u(_SEED_LO), xp)
         # Reserve (0, 0) for the hash-set EMPTY sentinel.
         is_sentinel = (hi == u(0)) & (lo == u(0))
         lo = xp.where(is_sentinel, u(1), lo)

@@ -175,8 +175,42 @@ def insert_auto(hs, fp_hi, fp_lo, val_hi, val_lo, active, *, max_probes: int = 3
             return insert_pallas(
                 hs, fp_hi, fp_lo, val_hi, val_lo, active, max_probes=max_probes
             )
-        except Exception:  # pragma: no cover - TPU lowering gaps
-            pass
+        except Exception as e:  # pragma: no cover - TPU lowering gaps
+            if not _is_lowering_failure(e):
+                raise  # genuine bugs (shapes, OOM, tracer leaks) propagate
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                import warnings
+
+                warnings.warn(
+                    f"Pallas hash-insert failed to lower; falling back to the "
+                    f"XLA insert for this process: {type(e).__name__}: {e}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
     return hashset.insert(
         hs, fp_hi, fp_lo, val_hi, val_lo, active, max_probes=max_probes
     )
+
+
+_warned_fallback = False
+
+
+def _is_lowering_failure(e: Exception) -> bool:
+    """Whether ``e`` is a failed Mosaic/Pallas *lowering* (fall back to the
+    XLA insert) as opposed to a genuine bug — shape mismatches, OOM, tracer
+    leaks — which must propagate. Mosaic rejections can surface either as
+    Python-level lowering exceptions or as an XLA runtime error whose
+    message names Mosaic, so both are matched; other runtime errors (e.g.
+    RESOURCE_EXHAUSTED) are not."""
+    if isinstance(e, NotImplementedError):
+        return True
+    name = type(e).__name__
+    if name in ("LoweringError", "LoweringException"):
+        return True
+    if name in ("XlaRuntimeError", "JaxRuntimeError") and (
+        "Mosaic" in str(e) or "mosaic" in str(e)
+    ):
+        return True
+    return False

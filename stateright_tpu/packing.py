@@ -39,6 +39,31 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 
+def bits_for(maxval: int) -> int:
+    """Field width (>=1) that holds values ``0..maxval``."""
+    return max(int(maxval).bit_length(), 1)
+
+
+class PackedModelAdapter:
+    """Object-level ``Model`` surface for packed models that wrap an inner
+    object model in ``self._inner`` (the pattern of the packed register and
+    Paxos models): every Model-API call — ``init_states``, ``actions``,
+    ``next_state``, ``properties``, ``within_boundary``, display hooks —
+    resolves to the inner model via ``__getattr__``; only ``checker()`` must
+    bind to the packed wrapper itself so ``spawn_xla`` sees the packed
+    kernels alongside the object-level contract."""
+
+    def checker(self):
+        from .checker.builder import CheckerBuilder
+
+        return CheckerBuilder(self)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
 class Field(NamedTuple):
     name: str
     bits: int  # bits per element
@@ -578,6 +603,28 @@ class BoundedHistory:
             jnp.where(do, (n + 1).astype(jnp.uint32), n.astype(jnp.uint32)),
         )
         return words, overflow
+
+    def valid_with_no_return_geq(self, words, min_ret_code: int):
+        """Device predicate: the history is unpoisoned AND no completed op
+        returned a code ``>= min_ret_code``.
+
+        This is the building block for conservative consistency predicates
+        over register-style histories (``history_codecs`` assigns WriteOk
+        code 0 and ReadOk codes ``>= 1``): with ``min_ret_code=1`` it reads
+        "valid and no completed read", which is exact-in-one-direction for
+        linearizability — completed-write-only histories always admit a
+        legal serialization, so only flagged states need the host's exact
+        backtracking serializer (SURVEY §7 M4a). Kept here so the +1
+        slot-storage offset stays private to this class."""
+        import jax.numpy as jnp
+
+        L = self.layout
+        ok = L.get(words, "h_valid") != 0
+        threshold = jnp.uint32(min_ret_code + 1)  # slots store code+1; 0 = empty
+        for t in range(len(self.thread_ids)):
+            for j in range(self.max_ops):
+                ok = ok & (L.get(words, f"h{t}_ret", j) < threshold)
+        return ok
 
     # --- host codec --------------------------------------------------------
 

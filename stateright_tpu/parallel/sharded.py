@@ -503,18 +503,29 @@ class ShardedXlaChecker(Checker):
                 axis=1,
             )  # [Fl*A, LANES]
 
-            # 5. pack per-destination routing buffers. Inactive slots stay
-            #    all-zero; (0,0) fingerprints mark them empty downstream.
-            buf = jnp.zeros((D, K, LANES), jnp.uint32)
-            route_ovf = jnp.bool_(False)
-            for d in range(D):
-                sel = vflat & (owner == d)
-                pos = jnp.cumsum(sel.astype(jnp.int32)) - 1
-                route_ovf = route_ovf | (jnp.sum(sel, dtype=jnp.int32) > K)
-                idx = jnp.where(sel & (pos < K), pos, K)
-                buf = buf.at[d, idx, :].set(
-                    jnp.where(sel[:, None], payload, 0), mode="drop"
-                )
+            # 5. pack per-destination routing buffers in one sort-by-owner
+            #    pass (each candidate has exactly one destination, so the
+            #    pack is O(Fl*A log) regardless of mesh size). A stable sort
+            #    keeps candidates in frontier order within each destination.
+            #    Inactive slots stay all-zero; (0,0) fingerprints mark them
+            #    empty downstream.
+            n_cand = Fl * A
+            owner_eff = jnp.where(vflat, owner.astype(jnp.int32), D)
+            order = jnp.argsort(owner_eff, stable=True)
+            sorted_owner = owner_eff[order]
+            starts = jnp.searchsorted(sorted_owner, jnp.arange(D + 1))
+            route_ovf = jnp.any(starts[1:] - starts[:-1] > K)
+            slot = jnp.arange(n_cand) - starts[jnp.clip(sorted_owner, 0, D - 1)]
+            keep = (sorted_owner < D) & (slot < K)
+            buf = (
+                jnp.zeros((D, K, LANES), jnp.uint32)
+                .at[
+                    jnp.where(keep, sorted_owner, D),
+                    jnp.where(keep, slot, K),
+                    :,
+                ]
+                .set(jnp.where(keep[:, None], payload[order], 0), mode="drop")
+            )
             route_ovf = jax.lax.pmax(route_ovf.astype(jnp.uint32), "shards") > 0
 
             # 6. the all-to-all: slice d of the result came from shard d.
@@ -678,6 +689,11 @@ class ShardedXlaChecker(Checker):
             return
         self._max_depth = max(self._max_depth, self._depth)
         if self._target_max_depth is not None and self._depth >= self._target_max_depth:
+            # Mirror the single-chip engine: a depth-halted checker reads as
+            # frontier-empty to counters and checkpoint consumers alike.
+            import jax.numpy as jnp
+
+            self._counts = jnp.zeros_like(self._counts)
             self._exhausted = True
             return
         if self._visitor is not None:

@@ -15,17 +15,23 @@ from __future__ import annotations
 from typing import Any, List, Sequence
 
 from ..fingerprint import fingerprint
+from .densenatmap import DenseNatMap
 
 
 class RewritePlan:
-    """A permutation plan: ``order[new_index] = old_index``."""
+    """A permutation plan: ``order[new_index] = old_index``.
+
+    The inverse mapping lives in a :class:`DenseNatMap` keyed by old index —
+    the same dense-natural-key container the reference's ``RewritePlan``
+    is built on (rewrite_plan.rs:19, densenatmap.rs:75)."""
 
     def __init__(self, order: Sequence[int]):
         self.order = list(order)
         # Inverse: new index of each old index.
-        self.new_of_old = [0] * len(self.order)
+        inverse = [0] * len(self.order)
         for new, old in enumerate(self.order):
-            self.new_of_old[old] = new
+            inverse[old] = new
+        self.new_of_old = DenseNatMap(inverse)
 
     @staticmethod
     def from_values_to_sort(values: Sequence[Any]) -> "RewritePlan":

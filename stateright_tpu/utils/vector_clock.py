@@ -57,6 +57,16 @@ class VectorClock:
             return NotImplemented
         return self.partial_cmp(other) in (-1, 0)
 
+    def __gt__(self, other):
+        if not isinstance(other, VectorClock):
+            return NotImplemented
+        return self.partial_cmp(other) == 1
+
+    def __ge__(self, other):
+        if not isinstance(other, VectorClock):
+            return NotImplemented
+        return self.partial_cmp(other) in (0, 1)
+
     def __eq__(self, other) -> bool:
         return isinstance(other, VectorClock) and self._values == other._values
 
@@ -68,3 +78,8 @@ class VectorClock:
 
     def __repr__(self) -> str:
         return f"VectorClock({list(self._values)!r})"
+
+    def __str__(self) -> str:
+        # Display parity with the reference (vector_clock.rs can_display):
+        # stored elements then an ellipsis for the implicit zeros.
+        return "<" + "".join(f"{v}, " for v in self._values) + "...>"

@@ -1,0 +1,96 @@
+"""Packed Paxos on the device engine — the flagship actor example on
+``spawn_xla`` (VERDICT.md round-1 item #3).
+
+Oracle: the reference's own test asserts 16,668 unique states at 2 clients /
+3 servers on an unordered non-duplicating network and an 8-action shortest
+witness for "value chosen" (examples/paxos.rs:294-346). The packed model must
+agree with the object model action-for-action (the differential test) and
+end-to-end on the device engine (the full-coverage test).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from stateright_tpu.actor.network import Envelope
+from stateright_tpu.models.paxos import PackedPaxos, paxos_model
+
+
+def _sample_states(model, n, seed=7, walk=4000):
+    """Random-walk sample of reachable states (mixed depths)."""
+    rng = random.Random(seed)
+    init = model.init_states()[0]
+    sample = {init}
+    cur = init
+    for _ in range(walk):
+        steps = list(model.next_steps(cur))
+        if not steps:
+            cur = init
+            continue
+        _, cur = rng.choice(steps)
+        sample.add(cur)
+        if len(sample) >= n:
+            break
+    return sorted(sample, key=repr)
+
+
+def test_codec_round_trips_and_differential_step_parity():
+    """For every sampled reachable state: pack/unpack is exact, and the
+    device action grid agrees with the object model action-for-action —
+    same enabled (non-no-op) deliveries, identical successor words."""
+    import jax
+    import jax.numpy as jnp
+
+    m = PackedPaxos(2, 3)
+    states = _sample_states(m._inner, 150)
+    packed = np.stack([m.pack(s) for s in states])
+    for s, row in zip(states, packed):
+        assert m.unpack(row) == s, f"codec round-trip mismatch for {s!r}"
+
+    nxt, valid, ovf = jax.jit(jax.vmap(m.packed_step))(jnp.asarray(packed))
+    nxt, valid, ovf = np.asarray(nxt), np.asarray(valid), np.asarray(ovf)
+    assert not ovf.any(), "codec overflow on reachable states"
+
+    for si, s in enumerate(states):
+        obj = {}
+        for action, ns in m._inner.next_steps(s):
+            code = m._env_code[Envelope(action.src, action.dst, action.msg)]
+            obj[code] = ns
+        assert set(np.nonzero(valid[si])[0].tolist()) == set(obj), (
+            f"enabled-action mismatch at state {si}"
+        )
+        for code, ns in obj.items():
+            np.testing.assert_array_equal(
+                nxt[si, code],
+                m.pack(ns),
+                err_msg=f"successor mismatch: state {si}, envelope {m._envs[code]!r}",
+            )
+
+
+@pytest.mark.slow
+def test_xla_matches_the_16668_state_oracle():
+    """Full coverage on the device engine: the reference's exact unique-state
+    count, a clean linearizability verdict (host-verified candidates all
+    pass), and the 8-action shortest witness for "value chosen"."""
+    from stateright_tpu.actor import register as reg
+
+    m = PackedPaxos(2, 3)
+    xc = m.checker().spawn_xla(
+        frontier_capacity=1 << 12,
+        table_capacity=1 << 16,
+        host_verified_cap=4096,
+    ).join()
+    assert xc.unique_state_count() == 16_668  # examples/paxos.rs:321,345
+    xc.assert_properties()
+    witness = xc.discoveries()["value chosen"]
+    pairs = witness.into_vec()
+    actions = [a for _s, a in pairs if a is not None]
+    assert len(actions) == 8  # BFS shortest witness (examples/paxos.rs:311-320)
+    assert isinstance(actions[0].msg, reg.Put)
+    assert isinstance(actions[-1].msg, reg.Get)
+    final = pairs[-1][0]
+    assert any(
+        isinstance(env.msg, reg.GetOk) and env.msg.value is not None
+        for env in final.network.iter_deliverable()
+    )

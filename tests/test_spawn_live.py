@@ -1,0 +1,108 @@
+"""Live UDP runtime test: two actors on loopback sockets exchange a
+timer-kicked ping-pong (VERDICT.md round-1 item #7).
+
+The reference leaves ``spawn()`` untested beyond the Id/addr codec
+(spawn.rs:204-220); this exercises the full loop — socket bind, timer
+deadline scheduling, receive dispatch, command processing — end to end in
+well under two seconds, with a deterministic outcome: every pong arrives
+exactly once, in order.
+"""
+
+import socket
+import threading
+import time
+
+from stateright_tpu.actor import Id
+from stateright_tpu.actor.spawn import json_codec, spawn
+from stateright_tpu.utils.variant import variant
+
+Ping = variant("Ping", ["n"])
+Pong = variant("Pong", ["n"])
+
+
+class Ponger:
+    """Echoes every Ping; counts handled messages in its state."""
+
+    def on_start(self, id, out):
+        return 0
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, Ping):
+            out.send(src, Pong(msg.n))
+            state.set(state.get() + 1)
+
+    def on_timeout(self, id, state, timer, out):
+        pass
+
+
+class Pinger:
+    """Starts pinging on a timer (the deterministic timer-path exercise),
+    resends the outstanding ping on a resend timer, and records pongs."""
+
+    def __init__(self, target, count, record, done):
+        self.target = target
+        self.count = count
+        self.record = record
+        self.done = done
+
+    def on_start(self, id, out):
+        out.set_timer("kick", (0.02, 0.02))
+        return 0  # the next expected pong
+
+    def on_timeout(self, id, state, timer, out):
+        # "kick" fires once to start; "resend" re-fires on packet loss.
+        if state.get() < self.count:
+            out.send(self.target, Ping(state.get()))
+            out.set_timer("resend", (0.4, 0.4))
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, Pong) and msg.n == state.get():
+            self.record.append(msg.n)
+            nxt = msg.n + 1
+            state.set(nxt)
+            if nxt < self.count:
+                out.send(src, Ping(nxt))
+            else:
+                out.cancel_timer("resend")
+                self.done.set()
+
+
+def _free_udp_ports(n):
+    socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM) for _ in range(n)]
+    ports = []
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_live_ping_pong_over_loopback_udp():
+    count = 5
+    ping_port, pong_port = _free_udp_ports(2)
+    pinger_id = Id.from_addr("127.0.0.1", ping_port)
+    ponger_id = Id.from_addr("127.0.0.1", pong_port)
+    serialize, deserialize = json_codec(Ping, Pong)
+
+    record: list = []
+    done = threading.Event()
+    handles = spawn(
+        serialize,
+        deserialize,
+        [
+            (ponger_id, Ponger()),
+            (pinger_id, Pinger(ponger_id, count, record, done)),
+        ],
+        background=True,
+    )
+    try:
+        assert done.wait(timeout=5.0), f"ping-pong stalled; got {record!r}"
+        # Deterministic: every pong exactly once, in order (duplicates from
+        # a resend race would be dropped by the expected-n check).
+        assert record == list(range(count))
+    finally:
+        for _t, runtime in handles:
+            runtime.stopped.set()
+        for t, _r in handles:
+            t.join(timeout=2.0)

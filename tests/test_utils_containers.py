@@ -1,0 +1,178 @@
+"""Utility-container tests: VectorClock (ported from the reference's own
+suite, vector_clock.rs:109-275) and DenseNatMap (densenatmap.rs:98-113,
+223-238), plus a model-level consumer — a vector-clock variant of the
+reference's logical-clock doc example (actor.rs:11-79) whose counterexample
+exercises increment/merge/partial-order inside a checked actor system.
+"""
+
+import pytest
+
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.utils.densenatmap import DenseNatMap
+from stateright_tpu.utils.rewrite_plan import RewritePlan, rewrite
+from stateright_tpu.utils.vector_clock import VectorClock
+
+# --- VectorClock (vector_clock.rs:109-275) --------------------------------
+
+
+def test_can_display():
+    assert str(VectorClock([1, 2, 3, 4])) == "<1, 2, 3, 4, ...>"
+    # Notably equal vectors don't necessarily display the same.
+    assert str(VectorClock([])) == "<...>"
+    assert str(VectorClock([0])) == "<...>"  # zero suffix trimmed at build
+
+
+def test_can_equate():
+    assert VectorClock() == VectorClock()
+    assert VectorClock([0]) == VectorClock([])
+    assert VectorClock([]) == VectorClock([0])
+    assert VectorClock([]) != VectorClock([1])
+    assert VectorClock([1]) != VectorClock([])
+
+
+def test_can_hash():
+    # same hash if equal
+    assert hash(VectorClock()) == hash(VectorClock())
+    assert hash(VectorClock([])) == hash(VectorClock([0, 0]))
+    assert hash(VectorClock([1])) == hash(VectorClock([1, 0]))
+    assert fingerprint(VectorClock([1])) == fingerprint(VectorClock([1, 0]))
+    # otherwise hash varies w/ high probability
+    assert hash(VectorClock([])) != hash(VectorClock([1]))
+    assert fingerprint(VectorClock([])) != fingerprint(VectorClock([1]))
+
+
+def test_can_increment():
+    assert VectorClock().incremented(2) == VectorClock([0, 0, 1])
+    assert VectorClock().incremented(2).incremented(0).incremented(2) == VectorClock(
+        [1, 0, 2]
+    )
+
+
+def test_can_merge():
+    assert VectorClock([1, 2, 3, 4]).merge_max(VectorClock([5, 6, 0])) == VectorClock(
+        [5, 6, 3, 4]
+    )
+    assert VectorClock([1, 0, 2]).merge_max(VectorClock([3, 1, 0, 4])) == VectorClock(
+        [3, 1, 2, 4]
+    )
+
+
+def test_can_order_partially():
+    # Clocks with matching elements are equal; missing elements are zero.
+    assert VectorClock([]).partial_cmp(VectorClock([])) == 0
+    assert VectorClock([]).partial_cmp(VectorClock([0, 0])) == 0
+    assert VectorClock([0, 0]).partial_cmp(VectorClock([])) == 0
+    assert VectorClock([1, 2, 0]).partial_cmp(VectorClock([1, 2])) == 0
+    # Less: at least one element less, the rest <=.
+    assert VectorClock([]).partial_cmp(VectorClock([1])) == -1
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([1, 3, 4])) == -1
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([1, 3, 3])) == -1
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([2, 3, 3])) == -1
+    assert VectorClock([1, 2, 3]) < VectorClock([2, 3, 3])
+    # Greater: at least one element greater, the rest >=.
+    assert VectorClock([1]).partial_cmp(VectorClock([])) == 1
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([1, 1, 2])) == 1
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([1, 1, 3])) == 1
+    assert VectorClock([1, 2, 4]).partial_cmp(VectorClock([0, 1, 3])) == 1
+    assert VectorClock([1, 2, 4]) > VectorClock([0, 1, 3])
+    # Incomparable when mixed.
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([1, 3, 2])) is None
+    assert VectorClock([1, 2, 3]).partial_cmp(VectorClock([3, 2, 1])) is None
+    assert VectorClock([1, 2, 2]).partial_cmp(VectorClock([2, 1, 2])) is None
+    assert not VectorClock([1, 2, 3]) < VectorClock([1, 3, 2])
+    assert not VectorClock([1, 2, 3]) > VectorClock([1, 3, 2])
+
+
+# --- DenseNatMap (densenatmap.rs:98-113, 223-238) -------------------------
+
+
+def test_dense_insert_and_lookup():
+    m = DenseNatMap()
+    m.insert(0, "a")
+    m.insert(1, "b")
+    m[1] = "B"  # overwrite in place
+    assert m[0] == "a" and m[1] == "B"
+    assert len(m) == 2
+    assert list(m.items()) == [(0, "a"), (1, "B")]
+    assert m.get(5) is None
+
+
+def test_insert_at_gap_raises():
+    m = DenseNatMap(["a"])
+    with pytest.raises(IndexError):
+        m.insert(2, "c")  # key 1 missing — keys must be dense
+
+
+def test_eq_hash_fingerprint():
+    assert DenseNatMap(["x", "y"]) == DenseNatMap(["x", "y"])
+    assert DenseNatMap(["x", "y"]) != DenseNatMap(["y", "x"])
+    assert hash(DenseNatMap(["x"])) == hash(DenseNatMap(["x"]))
+    assert fingerprint(DenseNatMap(["x"])) == fingerprint(DenseNatMap(["x"]))
+
+
+def test_rewrite_reindexes_by_plan():
+    """The reference's DenseNatMap Rewrite impl reindexes via the plan
+    (densenatmap.rs:223-238); RewritePlan itself stores its inverse in a
+    DenseNatMap (rewrite_plan.rs:19)."""
+    plan = RewritePlan.from_values_to_sort(["c", "a", "b"])
+    assert plan.order == [1, 2, 0]
+    assert isinstance(plan.new_of_old, DenseNatMap)
+    m = DenseNatMap(["c", "a", "b"])
+    assert rewrite(m, plan) == DenseNatMap(["a", "b", "c"])
+
+
+# --- model-level consumer: vector-clock actors ----------------------------
+
+
+class VectorClockActor:
+    """The reference's logical-clock doc actor (actor.rs:11-79) with a
+    VectorClock state: merge-and-increment on receive, reply while the
+    received clock dominates ours."""
+
+    def __init__(self, index, bootstrap_to_id=None):
+        self.index = index
+        self.bootstrap_to_id = bootstrap_to_id
+
+    def on_start(self, id, out):
+        if self.bootstrap_to_id is not None:
+            clock = VectorClock().incremented(self.index)
+            out.send(self.bootstrap_to_id, clock)
+            return clock
+        return VectorClock()
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, VectorClock) and msg.partial_cmp(state.get()) == 1:
+            merged = state.get().merge_max(msg).incremented(self.index)
+            state.set(merged)
+            out.send(src, merged)
+
+    def on_timeout(self, id, state, timer, out):
+        pass
+
+
+def test_vector_clock_actor_model_counterexample():
+    """Two actors bounce merged clocks; the false claim that no actor's own
+    component reaches 3 is disproved in exactly 4 deliveries."""
+    from stateright_tpu.actor import ActorModel, Id, Network
+    from stateright_tpu.core import Expectation
+
+    model = (
+        ActorModel(cfg=None)
+        .actor(VectorClockActor(0))
+        .actor(VectorClockActor(1, bootstrap_to_id=Id(0)))
+        .init_network(Network.new_unordered_duplicating())
+        .property(
+            Expectation.ALWAYS,
+            "less than max",
+            lambda _m, s: all(
+                clock.get(i) < 3 for i, clock in enumerate(s.actor_states)
+            ),
+        )
+    )
+    checker = model.checker().spawn_bfs().join()
+    witness = checker.discoveries()["less than max"]
+    pairs = witness.into_vec()
+    actions = [a for _s, a in pairs if a is not None]
+    assert len(actions) == 4
+    final = pairs[-1][0]
+    assert final.actor_states == (VectorClock([2, 2]), VectorClock([2, 3]))
