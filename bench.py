@@ -74,12 +74,9 @@ def _tpu_available(timeout_s: int) -> bool:
     return proc.returncode == 0 and "ok" in proc.stdout
 
 
-def _run_check(model, frontier_pow: int, table_pow: int, detail: list | None):
+def _run_check(model, detail: list | None, **spawn_kwargs):
     """One full-coverage check; returns (generated_states, seconds, checker)."""
-    checker = model.checker().spawn_xla(
-        frontier_capacity=1 << frontier_pow,
-        table_capacity=1 << table_pow,
-    )
+    checker = model.checker().spawn_xla(**spawn_kwargs)
     t0 = time.monotonic()
     states0 = checker.state_count()
     while not checker.is_done():
@@ -97,6 +94,56 @@ def _run_check(model, frontier_pow: int, table_pow: int, detail: list | None):
     elapsed = time.monotonic() - t0
     checker.assert_properties()
     return checker.state_count() - states0, elapsed, checker
+
+
+def _run_matrix(platform: str) -> list:
+    """Secondary configs (BASELINE.json metric: states/sec/chip AND
+    time-to-full-coverage): the flagship actor examples on the device
+    engine. Warm + measured pass each; small spaces, so these anchor
+    time-to-coverage rather than steady-state throughput."""
+    from stateright_tpu.models.paxos import PackedPaxos
+    from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
+
+    rows = []
+    for name, build, kwargs in [
+        (
+            "paxos 2c/3s packed",
+            lambda: PackedPaxos(2, 3),
+            dict(
+                frontier_capacity=1 << 12,
+                table_capacity=1 << 16,
+                host_verified_cap=4096,
+            ),
+        ),
+        (
+            "single-copy-register 2c/1s packed",
+            lambda: PackedSingleCopyRegister(2, 1),
+            dict(frontier_capacity=1 << 10, table_capacity=1 << 12),
+        ),
+    ]:
+        try:
+            model = build()
+            t0 = time.monotonic()
+            _run_check(model, None, **kwargs)  # warm: compiles
+            warm = time.monotonic() - t0
+            states, sec, checker = _run_check(model, None, **kwargs)
+            checker.assert_properties()
+            rows.append(
+                {
+                    "config": name,
+                    "platform": platform,
+                    "generated_states": states,
+                    "unique_states": checker.unique_state_count(),
+                    "warm_pass_sec": round(warm, 3),
+                    "time_to_full_coverage_sec": round(sec, 3),
+                    "states_per_sec": round(states / max(sec, 1e-9), 1),
+                }
+            )
+            _log(f"matrix {name}: {rows[-1]}")
+        except Exception as e:  # keep the primary metric alive no matter what
+            _log(f"matrix {name} FAILED: {type(e).__name__}: {e}")
+            rows.append({"config": name, "error": f"{type(e).__name__}: {e}"})
+    return rows
 
 
 def main() -> None:
@@ -140,17 +187,28 @@ def main() -> None:
     model = PackedTwoPhaseSys(rm)
 
     # Pass 1: warm every superstep bucket (compile time, excluded).
-    warm_states, warm_sec, _ = _run_check(model, frontier_pow, table_pow, None)
+    spawn_kwargs = dict(
+        frontier_capacity=1 << frontier_pow, table_capacity=1 << table_pow
+    )
+    warm_states, warm_sec, _ = _run_check(model, None, **spawn_kwargs)
     _log(f"warm pass: {warm_states} states in {warm_sec:.2f}s (compile included)")
 
     # Pass 2: measured steady-state run.
     detail: list = []
-    states, elapsed, checker = _run_check(model, frontier_pow, table_pow, detail)
+    states, elapsed, checker = _run_check(model, detail, **spawn_kwargs)
     value = states / max(elapsed, 1e-9)
     _log(
         f"measured pass: {states} states ({checker.unique_state_count()} unique, "
         f"depth {checker.max_depth()}) in {elapsed:.2f}s -> {value:,.0f} states/s"
     )
+
+    matrix = []
+    if os.environ.get("BENCH_MATRIX", "1") != "0":
+        try:
+            matrix = _run_matrix(platform)
+        except Exception as e:  # the primary metric line must survive
+            _log(f"matrix runner FAILED: {type(e).__name__}: {e}")
+            matrix = [{"error": f"{type(e).__name__}: {e}"}]
 
     with open(os.path.join(REPO, "bench_detail.json"), "w") as fh:
         json.dump(
@@ -164,6 +222,7 @@ def main() -> None:
                 "measured_sec": round(elapsed, 3),
                 "states_per_sec": round(value, 1),
                 "levels": detail,
+                "matrix": matrix,
             },
             fh,
             indent=1,

@@ -1,0 +1,118 @@
+"""CPU baseline measurements for BASELINE.md's "published" section.
+
+The reference's own harness (``bench.sh:18-34``) runs its example binaries
+under ``cargo run --release`` and greps the reporter's ``sec=`` line; this
+container has no Rust toolchain, so those numbers cannot be produced here.
+This script measures the equivalents this framework CAN run on the host:
+
+- the **host oracle engines** (single-threaded Python BFS/DFS — the
+  correctness oracles, not the performance path) on the BASELINE.json
+  config matrix, and
+- the **XLA engine on CPU** (the same compiled superstep the TPU runs) on
+  the packed models, which anchors the device-vs-host comparison when no
+  chip is reachable.
+
+Run: ``python bench_cpu.py`` (forces the CPU backend). Prints one JSON line
+per config; paste the table into BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _time_checker(build):
+    t0 = time.monotonic()
+    checker = build()
+    if hasattr(checker, "join"):
+        checker.join()
+    sec = time.monotonic() - t0
+    return {
+        "states": checker.state_count(),
+        "unique": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "sec": round(sec, 3),
+        "states_per_sec": round(checker.state_count() / max(sec, 1e-9), 1),
+    }
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from stateright_tpu.actor import Network
+    from stateright_tpu.models.increment_lock import IncrementLock
+    from stateright_tpu.models.linearizable_register import (
+        linearizable_register_model,
+    )
+    from stateright_tpu.models.paxos import PackedPaxos, paxos_model
+    from stateright_tpu.models.single_copy_register import (
+        PackedSingleCopyRegister,
+        single_copy_register_model,
+    )
+    from stateright_tpu.models.two_phase_commit import (
+        PackedTwoPhaseSys,
+        TwoPhaseSys,
+    )
+
+    configs = [
+        # Host oracle engines on the BASELINE.json config matrix
+        # (bench.sh runs `check` = DFS in the reference examples).
+        ("2pc rm=3, host dfs", lambda: TwoPhaseSys(3).checker().spawn_dfs()),
+        ("2pc rm=5, host dfs", lambda: TwoPhaseSys(5).checker().spawn_dfs()),
+        (
+            "paxos 2c/3s, host dfs",
+            lambda: paxos_model(2, 3).checker().spawn_dfs(),
+        ),
+        (
+            "single-copy-register 3c/1s, host dfs",
+            lambda: single_copy_register_model(3, 1).checker().spawn_dfs(),
+        ),
+        (
+            "linearizable-register 2c/2s, host dfs",
+            lambda: linearizable_register_model(2, 2).checker().spawn_dfs(),
+        ),
+        (
+            "linearizable-register 2c/2s ordered, host dfs",
+            lambda: linearizable_register_model(
+                2, 2, Network.new_ordered()
+            ).checker().spawn_dfs(),
+        ),
+        (
+            "increment_lock, host dfs",
+            lambda: IncrementLock().checker().spawn_dfs(),
+        ),
+        # The XLA engine on the CPU backend (same compiled superstep as TPU).
+        (
+            "2pc rm=5 packed, spawn_xla cpu",
+            lambda: PackedTwoPhaseSys(5)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 15),
+        ),
+        (
+            "paxos 2c/3s packed, spawn_xla cpu",
+            lambda: PackedPaxos(2, 3)
+            .checker()
+            .spawn_xla(
+                frontier_capacity=1 << 12,
+                table_capacity=1 << 16,
+                host_verified_cap=4096,
+            ),
+        ),
+        (
+            "single-copy-register 2c/1s packed, spawn_xla cpu",
+            lambda: PackedSingleCopyRegister(2, 1)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 12),
+        ),
+    ]
+    for name, build in configs:
+        row = _time_checker(build)
+        row["config"] = name
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

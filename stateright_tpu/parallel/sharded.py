@@ -72,6 +72,7 @@ class ShardedXlaChecker(Checker):
         table_capacity: int = 1 << 20,
         route_capacity: Optional[int] = None,
         max_probes: int = 32,
+        visit_cap: int = 4096,
         checkpoint: Optional[str] = None,
     ):
         import jax
@@ -100,6 +101,7 @@ class ShardedXlaChecker(Checker):
         self._target_state_count = builder._target_state_count
         self._target_max_depth = builder._target_max_depth
         self._visitor = builder._visitor
+        self._visit_cap = visit_cap
         self._properties = model.properties()
         self._prop_names = [p.name for p in self._properties]
         self._ebit_of_prop: Dict[int, int] = {}
@@ -748,11 +750,29 @@ class ShardedXlaChecker(Checker):
             self._target_reached = True
 
     def _visit_frontier(self) -> None:
+        """Same visitor truncation contract as the single-chip engine: at
+        most ``spawn_xla(visit_cap=...)`` states per level, loud warning."""
         rows = np.asarray(self._frontier).reshape(self._D, self._Fl, self._W)
         counts = np.asarray(self._counts)
+        total = int(counts.sum())
+        if total > self._visit_cap:
+            import warnings
+
+            warnings.warn(
+                f"visitor: frontier has {total} states at depth {self._depth};"
+                f" visiting only the first {self._visit_cap} (host-side path "
+                "reconstruction per state does not scale — use visitors on "
+                "small runs, or raise spawn_xla(visit_cap=...))",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         parents = self._parent_map()
+        budget = self._visit_cap
         for d in range(self._D):
             for row in rows[d, : counts[d]]:
+                if budget <= 0:
+                    return
+                budget -= 1
                 fp = fphash.fingerprint_u64(
                     self._dedup_words_host(row[None, :])[0], np
                 )

@@ -97,6 +97,7 @@ class XlaChecker(Checker):
         table_capacity: int = 1 << 20,
         max_probes: int = 32,
         host_verified_cap: int = 128,
+        visit_cap: int = 4096,
         checkpoint: Optional[str] = None,
     ):
         import jax
@@ -146,6 +147,8 @@ class XlaChecker(Checker):
         # spawn_xla(host_verified_cap=...) raises it for models whose
         # conservative predicates flag wide swaths of the frontier.
         self._hv_cap = host_verified_cap
+        # Per-level ceiling on host-side visitor path reconstruction.
+        self._visit_cap = visit_cap
 
         # --- device state ------------------------------------------------
         import jax.numpy as jnp
@@ -649,9 +652,24 @@ class XlaChecker(Checker):
 
     def _visit_frontier(self) -> None:
         """Applies the visitor to every frontier state's path (the XLA
-        analogue of bfs.rs:274-276). Host-side and slow; meant for small
-        runs and debugging."""
-        rows = np.asarray(self._frontier)[: self._frontier_count]
+        analogue of bfs.rs:274-276). Host-side path reconstruction re-executes
+        the object model per state and would appear to hang on big frontiers,
+        so levels wider than ``spawn_xla(visit_cap=...)`` are truncated with
+        a loud warning — visitors are a debug/recording surface, not part of
+        checking semantics."""
+        n = self._frontier_count
+        if n > self._visit_cap:
+            import warnings
+
+            warnings.warn(
+                f"visitor: frontier has {n} states at depth {self._depth}; "
+                f"visiting only the first {self._visit_cap} (host-side path "
+                "reconstruction per state does not scale — use visitors on "
+                "small runs, or raise spawn_xla(visit_cap=...))",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        rows = np.asarray(self._frontier)[: min(n, self._visit_cap)]
         parents = self._parent_map()
         for row in rows:
             fp = fphash.fingerprint_u64(self._dedup_words_host(row[None, :])[0], np)
