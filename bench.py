@@ -101,11 +101,21 @@ def _run_matrix(platform: str) -> list:
     time-to-full-coverage): the flagship actor examples on the device
     engine. Warm + measured pass each; small spaces, so these anchor
     time-to-coverage rather than steady-state throughput."""
+    from stateright_tpu.models.linearizable_register import PackedAbd
     from stateright_tpu.models.paxos import PackedPaxos
     from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
 
     rows = []
     for name, build, kwargs in [
+        (
+            "linearizable-register (ABD) 2c/2s packed",
+            lambda: PackedAbd(2, 2),
+            dict(
+                frontier_capacity=1 << 10,
+                table_capacity=1 << 12,
+                host_verified_cap=1024,
+            ),
+        ),
         (
             "paxos 2c/3s packed",
             lambda: PackedPaxos(2, 3),

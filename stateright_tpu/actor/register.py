@@ -131,6 +131,114 @@ def history_codecs(values):
 ClientState = variant("ClientState", ["awaiting", "op_count"])
 
 
+class PackedClientsMixin:
+    """Shared device-side machinery for packed models whose clients are
+    :class:`RegisterClient` actors (register.rs:94-260, ``put_count=1``).
+
+    Host codec + vectorized delivery bodies for the client-facing protocol
+    half (PutOk/GetOk), over layout fields declared by :meth:`_client_layout`
+    and a bounded history ``self._hist``
+    (:class:`~stateright_tpu.packing.BoundedHistory`). Expects on ``self``:
+    ``S`` (server count), ``C`` (client count), ``_layout``, ``_hist``,
+    ``_OverflowError32``.
+
+    Client state encoding: ``cl_await`` 0 = idle, 1 = awaiting PutOk of
+    request ``1*i``, 2 = awaiting GetOk of request ``2*i`` (i = S + k);
+    ``cl_ops`` mirrors ``ClientState.op_count``.
+    """
+
+    def _client_layout(self, b) -> None:
+        b.array("cl_await", self.C, 2)
+        b.array("cl_ops", self.C, 2)
+
+    # --- host codec --------------------------------------------------------
+
+    def _pack_clients(self, fields, state) -> None:
+        S, C = self.S, self.C
+        fields["cl_await"] = [0] * C
+        fields["cl_ops"] = [0] * C
+        for k in range(C):
+            i = S + k
+            cs = state.actor_states[S + k]
+            if cs.awaiting is None:
+                fields["cl_await"][k] = 0
+            elif cs.awaiting == 1 * i:
+                fields["cl_await"][k] = 1
+            elif cs.awaiting == 2 * i:
+                fields["cl_await"][k] = 2
+            else:  # pragma: no cover - unreachable by construction
+                raise self._OverflowError32(f"unexpected request id {cs.awaiting}")
+            fields["cl_ops"][k] = cs.op_count
+
+    def _unpack_clients(self, f, actor_states) -> None:
+        S, C = self.S, self.C
+        for k in range(C):
+            i = S + k
+            awaiting = {0: None, 1: 1 * i, 2: 2 * i}[f["cl_await"][k]]
+            actor_states.append(
+                ClientState(awaiting=awaiting, op_count=f["cl_ops"][k])
+            )
+
+    # --- presence-bit network helpers --------------------------------------
+    # The universe's non-duplicating multiset packs as a "net" 1-bit array
+    # (empirically every register protocol here keeps counts at 1; a double
+    # send cannot be represented and reports overflow, SURVEY §7 #2).
+
+    def _net_take(self, words, e):
+        """Consume the delivered envelope; returns (was-present, words')."""
+        L = self._layout
+        return L.get(words, "net", e) != 0, L.set(words, "net", 0, e)
+
+    def _net_send(self, w, idx):
+        """Set a presence bit at a (possibly traced) code; returns
+        (words', was-already-present)."""
+        L = self._layout
+        dup = L.get(w, "net", idx) != 0
+        return L.set(w, "net", 1, idx), dup
+
+    # --- vectorized delivery bodies ----------------------------------------
+    # Each takes (words[W], e, prm[cols]) with traced envelope code and
+    # parameter row; returns (words'[W], valid, overflow). The history
+    # thread index is traced, so history ops unroll over C with masks.
+
+    def _body_putok(self, words, e, prm):
+        """PutOk -> client ``prm[0]``: record the WriteOk return, invoke the
+        Read, send Get ``prm[1]`` (register.rs:170-185)."""
+        import jax.numpy as jnp
+
+        L, u32 = self._layout, jnp.uint32
+        p, get_code = prm[0], prm[1]
+        deliv, w = self._net_take(words, e)
+        ok = deliv & (L.get(words, "cl_await", p) == u32(1))
+        w = L.set(w, "cl_await", 2, p)
+        w = L.set(w, "cl_ops", 2, p)
+        o = jnp.bool_(False)
+        for t in range(self.C):
+            on = ok & (p == u32(t))
+            w, ot = self._hist.on_return(w, t, u32(0), enabled=on)  # WriteOk
+            w = self._hist.on_invoke(w, t, u32(0), enabled=on)  # Read
+            o = o | ot
+        w, dup = self._net_send(w, get_code)
+        return w, ok, ok & (o | dup)
+
+    def _body_getok(self, words, e, prm):
+        """GetOk -> client ``prm[0]``: record the ReadOk return with (static)
+        ret code ``prm[1]``; the script completes (register.rs:186-187)."""
+        import jax.numpy as jnp
+
+        L, u32 = self._layout, jnp.uint32
+        k, ret_code = prm[0], prm[1]
+        deliv, w = self._net_take(words, e)
+        ok = deliv & (L.get(words, "cl_await", k) == u32(2))
+        w = L.set(w, "cl_await", 0, k)
+        w = L.set(w, "cl_ops", 3, k)
+        o = jnp.bool_(False)
+        for t in range(self.C):
+            w, ot = self._hist.on_return(w, t, ret_code, enabled=ok & (k == u32(t)))
+            o = o | ot
+        return w, ok, ok & o
+
+
 class RegisterClient:
     """A test client that performs ``put_count`` Puts, then one Get,
     round-robin across the servers (register.rs:94-260).

@@ -32,6 +32,7 @@ from ..actor import (
 )
 from ..actor import register as reg
 from ..core import Expectation
+from ..packing import PackedModelAdapter
 from ..semantics import LinearizabilityTester
 from ..semantics.register import Register
 from ..utils.variant import variant
@@ -193,6 +194,574 @@ def linearizable_register_model(
         .record_msg_in(reg.record_returns)
         .record_msg_out(reg.record_invocations)
     )
+
+
+class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
+    """The ABD quorum register on the device engine (``spawn_xla``), for the
+    oracle configuration: 2 clients / 2 servers, 544 unique states
+    (linearizable-register.rs:289,316).
+
+    Same construction as :class:`~stateright_tpu.models.paxos.PackedPaxos`:
+    a syntactically closed envelope universe as presence bits (empirically
+    all counts stay at 1), per-message-family vectorized delivery bodies
+    vmapped over parameter tables, and the ``LinearizabilityTester`` history
+    carried via :class:`~stateright_tpu.packing.BoundedHistory` with the
+    ``linearizable`` property host-verified (conservative device predicate +
+    exact backtracking serializer on flagged candidates).
+
+    Codec bounds (verified by full enumeration of the object model):
+    logical clocks are bounded by the Put count (each Put bumps the max
+    clock once), so sequencers form the closed set ``(clock 0..C, writer)``;
+    Phase1 response values and AckQuery/Record payloads pack as
+    ``seq_code * NV + val_code``. The 2-server restriction keeps quorum
+    arithmetic static (majority = 2: the coordinator's self-entry plus the
+    single peer); wider clusters model-check on the host engines.
+    """
+
+    host_verified_properties = frozenset({"linearizable"})
+
+    def __init__(self, client_count: int = 2, server_count: int = 2):
+        from ..actor.network import Envelope
+        from ..packing import BoundedHistory, LayoutBuilder, OverflowError32, bits_for
+
+        if (client_count, server_count) != (2, 2):
+            raise ValueError(
+                "PackedAbd packs the 2-client/2-server oracle configuration; "
+                "other sizes run on the host engines"
+            )
+        C = S = 2
+        self.C, self.S = C, S
+        self.majority = S // 2 + 1
+        self._inner = linearizable_register_model(C, S)
+        self._OverflowError32 = OverflowError32
+
+        #: values[0] is the unwritten None; client k writes values[1+k].
+        self.values = [None] + [chr(ord("A") + k) for k in range(C)]
+        NV = len(self.values)
+        self.NV = NV
+        #: seq codes, monotone in the model's (clock, Id) order:
+        #: code = clock * S + writer, clock 0..C.
+        self._seqs = [(c, Id(w)) for c in range(C + 1) for w in range(S)]
+        NSQ = len(self._seqs)
+        self.NSQ = NSQ
+        NSV = NSQ * NV  # (seq, value) pair codes
+
+        # Per-server request universe: server s coordinates the Put of
+        # client s (request id S+s) and the Get of client (s+1)%S
+        # (request id 2*(S+(s+1)%S)); req_bit 0 = that Put, 1 = that Get.
+        def req_id(s: int, req_bit: int) -> int:
+            return (S + s) if req_bit == 0 else 2 * (S + (s + 1) % S)
+
+        def requester(s: int, req_bit: int) -> int:
+            return (S + s) if req_bit == 0 else (S + (s + 1) % S)
+
+        self._req_id, self._requester = req_id, requester
+
+        # --- the closed envelope universe -------------------------------
+        envs: list = []
+        handlers: list = []
+        self._code_put: list = []
+        self._code_putok: list = []
+        self._code_get: list = []
+        self._base_getok: list = []
+        self._code_query: dict = {}
+        self._base_ackquery: dict = {}
+        self._base_record: dict = {}
+        self._code_ackrecord: dict = {}
+
+        for k in range(C):
+            i = S + k
+            self._code_put.append(len(envs))
+            envs.append(Envelope(Id(i), Id(i % S), reg.Put(i, self.values[1 + k])))
+            handlers.append(("begin", (i % S, 0)))
+        for k in range(C):
+            self._code_putok.append(len(envs))
+            envs.append(Envelope(Id(k % S), Id(S + k), reg.PutOk(S + k)))
+            handlers.append(("putok", (k,)))
+        for k in range(C):
+            i = S + k
+            self._code_get.append(len(envs))
+            envs.append(Envelope(Id(i), Id((i + 1) % S), reg.Get(2 * i)))
+            handlers.append(("begin", ((i + 1) % S, 1)))
+        for k in range(C):
+            i = S + k
+            self._base_getok.append(len(envs))
+            for v in range(NV):
+                envs.append(
+                    Envelope(Id((i + 1) % S), Id(i), reg.GetOk(2 * i, self.values[v]))
+                )
+                handlers.append(("getok", (k, v)))
+        for c in range(S):  # Query: coordinator c -> its peer
+            p = (c + 1) % S
+            for rb in range(2):
+                self._code_query[(c, rb)] = len(envs)
+                envs.append(Envelope(Id(c), Id(p), reg.Internal(Query(req_id(c, rb)))))
+                handlers.append(("query", (p, c, rb)))
+        for c in range(S):  # AckQuery: peer -> coordinator, contiguous in (seq, val)
+            p = (c + 1) % S
+            for rb in range(2):
+                self._base_ackquery[(c, rb)] = len(envs)
+                for sq in range(NSQ):
+                    for v in range(NV):
+                        envs.append(
+                            Envelope(
+                                Id(p),
+                                Id(c),
+                                reg.Internal(
+                                    AckQuery(
+                                        req_id(c, rb), self._seqs[sq], self.values[v]
+                                    )
+                                ),
+                            )
+                        )
+                        handlers.append(("ackquery", (c, rb, p, sq * NV + v)))
+        for c in range(S):  # Record: coordinator -> peer, contiguous in (seq, val)
+            p = (c + 1) % S
+            for rb in range(2):
+                self._base_record[(c, rb)] = len(envs)
+                for sq in range(NSQ):
+                    for v in range(NV):
+                        envs.append(
+                            Envelope(
+                                Id(c),
+                                Id(p),
+                                reg.Internal(
+                                    Record(
+                                        req_id(c, rb), self._seqs[sq], self.values[v]
+                                    )
+                                ),
+                            )
+                        )
+                        handlers.append(("record", (p, c, rb, sq * NV + v)))
+        for c in range(S):  # AckRecord: peer -> coordinator
+            p = (c + 1) % S
+            for rb in range(2):
+                self._code_ackrecord[(c, rb)] = len(envs)
+                envs.append(
+                    Envelope(Id(p), Id(c), reg.Internal(AckRecord(req_id(c, rb))))
+                )
+                handlers.append(("ackrecord", (c, rb, p)))
+
+        self._envs = envs
+        self._handlers = handlers
+        self._env_code = {env: code for code, env in enumerate(envs)}
+        self._U = len(envs)
+        self.max_actions = self._U
+
+        # --- layout ------------------------------------------------------
+        b = LayoutBuilder()
+        b.array("seq", S, bits_for(NSQ - 1))
+        b.array("val", S, bits_for(NV - 1))
+        b.array("kind", S, 2)  # 0 = no phase, 1 = Phase1, 2 = Phase2
+        b.array("p_req", S, 1)  # req_bit of the active phase
+        b.array("read", S, 2)  # Phase2: 0 = write op, 1+v = read of values[v]
+        b.array("rp", S * S, 1)  # Phase1 responses presence, idx s*S + key
+        b.array("rv", S * S, bits_for(NSV - 1))  # Phase1 (seq,val) codes
+        b.array("ak", S * S, 1)  # Phase2 acks, idx s*S + voter
+        self._client_layout(b)
+        b.array("net", self._U, 1)
+        code_bits = bits_for(NV)
+        self._hist = BoundedHistory(
+            b,
+            thread_ids=[Id(S + k) for k in range(C)],
+            max_ops=2,
+            op_bits=code_bits,
+            ret_bits=code_bits,
+        )
+        self._layout = b.finish()
+        self._hist.bind(self._layout)
+        self.state_words = self._layout.words
+
+        codecs = reg.history_codecs(self.values)
+        self._op_code, self._code_op, self._ret_code, self._code_ret = codecs
+
+        self._families = self._build_families()
+
+    # --- code helpers -------------------------------------------------------
+
+    def _seq_code(self, seq) -> int:
+        try:
+            return self._seqs.index(seq)
+        except ValueError:
+            raise self._OverflowError32(f"sequencer outside universe: {seq!r}")
+
+    def _val_code(self, val) -> int:
+        try:
+            return self.values.index(val)
+        except ValueError:
+            raise self._OverflowError32(f"value outside universe: {val!r}")
+
+    def _sv_code(self, seq, val) -> int:
+        return self._seq_code(seq) * self.NV + self._val_code(val)
+
+    def _phase_rb(self, s: int, phase) -> int:
+        """The validated req_bit of server ``s``'s active phase: its request
+        id and requester must be the ones this server can coordinate."""
+        rb = 0 if phase.request_id == self._req_id(s, 0) else 1
+        if phase.request_id != self._req_id(s, rb) or int(
+            phase.requester_id
+        ) != self._requester(s, rb):
+            raise self._OverflowError32(f"phase request outside universe: {phase!r}")
+        return rb
+
+    def _build_families(self):
+        import numpy as np
+
+        def params_for(kind: str, params) -> list:
+            if kind == "begin":
+                c, rb = params
+                return [c, rb, self._code_query[(c, rb)]]
+            if kind == "putok":
+                (k,) = params
+                return [k, self._code_get[k]]
+            if kind == "getok":
+                k, v = params
+                return [k, 1 + v]  # ReadOk(values[v]) ret code
+            if kind == "query":
+                p, c, rb = params
+                return [p, self._base_ackquery[(c, rb)]]
+            if kind == "ackquery":
+                c, rb, p, sv = params
+                return [c, rb, p, sv, self._base_record[(c, rb)], 1 + c]
+            if kind == "record":
+                p, c, rb, sv = params
+                return [p, sv, self._code_ackrecord[(c, rb)]]
+            # "ackrecord"
+            c, rb, p = params
+            putok = self._code_putok[c] if rb == 0 else 0
+            getok_base = self._base_getok[(c + 1) % self.S] if rb == 1 else 0
+            return [c, rb, p, putok, getok_base]
+
+        families = []
+        start = 0
+        while start < self._U:
+            kind = self._handlers[start][0]
+            end = start
+            while end < self._U and self._handlers[end][0] == kind:
+                end += 1
+            rows = [params_for(kind, self._handlers[e][1]) for e in range(start, end)]
+            families.append(
+                (
+                    kind,
+                    np.arange(start, end, dtype=np.uint32),
+                    np.asarray(rows, dtype=np.uint32),
+                )
+            )
+            start = end
+        return families
+
+    # --- codec -------------------------------------------------------------
+
+    def pack(self, state):
+        S, C = self.S, self.C
+        fields: dict = {
+            "seq": [0] * S,
+            "val": [0] * S,
+            "kind": [0] * S,
+            "p_req": [0] * S,
+            "read": [0] * S,
+            "rp": [0] * (S * S),
+            "rv": [0] * (S * S),
+            "ak": [0] * (S * S),
+        }
+        for s in range(S):
+            a: AbdState = state.actor_states[s]
+            fields["seq"][s] = self._seq_code(a.seq)
+            fields["val"][s] = self._val_code(a.val)
+            if isinstance(a.phase, Phase1):
+                rb = self._phase_rb(s, a.phase)
+                expected_write = (self.values[1 + s],) if rb == 0 else None
+                if a.phase.write != expected_write:
+                    raise self._OverflowError32(
+                        f"phase write outside universe: {a.phase!r}"
+                    )
+                fields["kind"][s] = 1
+                fields["p_req"][s] = rb
+                for key, (sq, v) in a.phase.responses:
+                    j = int(key)
+                    if not 0 <= j < S:
+                        raise self._OverflowError32(f"response key {key!r}")
+                    fields["rp"][s * S + j] = 1
+                    fields["rv"][s * S + j] = self._sv_code(sq, v)
+            elif isinstance(a.phase, Phase2):
+                rb = self._phase_rb(s, a.phase)
+                fields["kind"][s] = 2
+                fields["p_req"][s] = rb
+                if a.phase.read is not None:
+                    fields["read"][s] = 1 + self._val_code(a.phase.read[0])
+                for j in a.phase.acks:
+                    fields["ak"][s * S + int(j)] = 1
+            elif a.phase is not None:  # pragma: no cover
+                raise self._OverflowError32(f"unknown phase {a.phase!r}")
+        self._pack_clients(fields, state)
+        net = [0] * self._U
+        for env, count in state.network.counts.items():
+            code = self._env_code.get(env)
+            if code is None:
+                raise self._OverflowError32(f"envelope outside universe: {env!r}")
+            if count > 1:
+                raise self._OverflowError32(
+                    f"envelope count {count} > 1 (presence-bit codec): {env!r}"
+                )
+            net[code] = count
+        fields["net"] = net
+        fields.update(
+            self._hist.from_tester(state.history, self._op_code, self._ret_code)
+        )
+        return self._layout.pack(**fields)
+
+    def unpack(self, words):
+        from ..actor.model_state import ActorModelState
+        from ..actor.network import UnorderedNonDuplicatingNetwork
+        from ..actor.timers import Timers
+        from ..semantics import LinearizabilityTester
+        from ..semantics.register import Register
+
+        f = self._layout.unpack(words)
+        S, C, NV = self.S, self.C, self.NV
+        actor_states = []
+        for s in range(S):
+            kind = f["kind"][s]
+            rb = f["p_req"][s]
+            phase = None
+            if kind == 1:
+                responses = frozenset(
+                    (
+                        Id(j),
+                        (
+                            self._seqs[f["rv"][s * S + j] // NV],
+                            self.values[f["rv"][s * S + j] % NV],
+                        ),
+                    )
+                    for j in range(S)
+                    if f["rp"][s * S + j]
+                )
+                phase = Phase1(
+                    request_id=self._req_id(s, rb),
+                    requester_id=Id(self._requester(s, rb)),
+                    write=(self.values[1 + s],) if rb == 0 else None,
+                    responses=responses,
+                )
+            elif kind == 2:
+                read = None
+                if f["read"][s]:
+                    read = (self.values[f["read"][s] - 1],)
+                phase = Phase2(
+                    request_id=self._req_id(s, rb),
+                    requester_id=Id(self._requester(s, rb)),
+                    read=read,
+                    acks=frozenset(Id(j) for j in range(S) if f["ak"][s * S + j]),
+                )
+            actor_states.append(
+                AbdState(
+                    seq=self._seqs[f["seq"][s]],
+                    val=self.values[f["val"][s]],
+                    phase=phase,
+                )
+            )
+        self._unpack_clients(f, actor_states)
+        counts = {
+            self._envs[code]: count for code, count in enumerate(f["net"]) if count
+        }
+        history = self._hist.to_tester(
+            f,
+            lambda: LinearizabilityTester(Register(None)),
+            self._code_op,
+            self._code_ret,
+        )
+        return ActorModelState(
+            actor_states=tuple(actor_states),
+            network=UnorderedNonDuplicatingNetwork(counts),
+            timers_set=tuple(Timers() for _ in range(S + C)),
+            history=history,
+        )
+
+    # --- device kernels -----------------------------------------------------
+
+    def packed_init(self):
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self._inner.init_states()])
+
+    def packed_step(self, words):
+        """Full action fan-out, one vectorized body per ABD message family
+        (linearizable-register.rs:82-210)."""
+        import jax
+        import jax.numpy as jnp
+
+        nxts, valids, ovfs = [], [], []
+        for kind, codes, prm in self._families:
+            body = getattr(self, "_body_" + kind)
+            nxt, valid, ovf = jax.vmap(body, in_axes=(None, 0, 0))(
+                words, jnp.asarray(codes), jnp.asarray(prm)
+            )
+            nxts.append(nxt)
+            valids.append(valid)
+            ovfs.append(ovf)
+        valid = jnp.concatenate(valids)
+        return jnp.concatenate(nxts), valid, jnp.concatenate(ovfs) & valid
+
+    def _body_begin(self, words, e, prm):
+        """Put/Get -> its coordinator: begin phase 1 seeded with the local
+        pair, Query the peer (linearizable-register.rs:86-111)."""
+        import jax.numpy as jnp
+
+        L, S, u32 = self._layout, self.S, jnp.uint32
+        c, rb, query_code = prm[0], prm[1], prm[2]
+        deliv, w = self._net_take(words, e)
+        ok = deliv & (L.get(words, "kind", c) == 0)
+        w = L.set(w, "kind", 1, c)
+        w = L.set(w, "p_req", rb, c)
+        own = L.get(words, "seq", c) * u32(self.NV) + L.get(words, "val", c)
+        w = L.set(w, "rp", 1, c * S + c)
+        w = L.set(w, "rv", own, c * S + c)
+        w, dup = self._net_send(w, query_code)
+        return w, ok, ok & dup
+
+    def _body_query(self, words, e, prm):
+        """Query -> the peer: reply with the local pair, no state change
+        (linearizable-register.rs:113-116)."""
+        import jax.numpy as jnp
+
+        L, u32 = self._layout, jnp.uint32
+        d, ackq_base = prm[0], prm[1]
+        deliv, w = self._net_take(words, e)
+        own = L.get(words, "seq", d) * u32(self.NV) + L.get(words, "val", d)
+        w, dup = self._net_send(w, ackq_base + own)
+        return w, deliv, deliv & dup
+
+    def _body_ackquery(self, words, e, prm):
+        """AckQuery -> the coordinator: collect; on quorum pick the maximal
+        pair, bump the clock for writes, Record to the peer, move to phase 2
+        (linearizable-register.rs:118-176)."""
+        import jax.numpy as jnp
+
+        L, S, u32 = self._layout, self.S, jnp.uint32
+        NV = self.NV
+        c, rb, p, sv, record_base, wval = (
+            prm[0],
+            prm[1],
+            prm[2],
+            prm[3],
+            prm[4],
+            prm[5],
+        )
+        deliv, w = self._net_take(words, e)
+        ok = (
+            deliv
+            & (L.get(words, "kind", c) == 1)
+            & (L.get(words, "p_req", c) == rb)
+        )
+        w = L.set(w, "rp", 1, c * S + p)
+        w = L.set(w, "rv", sv, c * S + p)
+        count = u32(0)
+        best = u32(0)
+        for j in range(S):
+            mine = p == u32(j)
+            pj = jnp.where(mine, u32(1), L.get(words, "rp", c * S + j))
+            vj = jnp.where(mine, sv, L.get(words, "rv", c * S + j))
+            count = count + pj
+            # max by (seq, val) == max by seq: equal sequencers carry equal
+            # values (linearizable-register.rs:139-142).
+            best = jnp.maximum(best, jnp.where(pj != 0, vj, u32(0)))
+        quorum = count == u32(self.majority)
+        best_seq = best // u32(NV)
+        clock = best_seq // u32(S)
+        is_write = rb == 0
+        o = quorum & is_write & (clock >= u32(self.C))  # clock would overflow
+        seq2 = jnp.where(
+            is_write, (clock + u32(1)) * u32(S) + u32(c), best_seq
+        )
+        val2 = jnp.where(is_write, wval, best % u32(NV))
+        sv2 = seq2 * u32(NV) + val2
+        w2 = w
+        for j in range(S):  # responses cleared on the phase switch
+            w2 = L.set(w2, "rp", 0, c * S + j)
+            w2 = L.set(w2, "rv", 0, c * S + j)
+        w2 = L.set(w2, "kind", 2, c)
+        w2 = L.set(w2, "read", jnp.where(is_write, u32(0), u32(1) + val2), c)
+        for j in range(S):  # acks := {c}
+            w2 = L.set(w2, "ak", 0, c * S + j)
+        w2 = L.set(w2, "ak", 1, c * S + c)
+        # Self-send Record: adopt if newer (seq codes are order-monotone).
+        newer = seq2 > L.get(words, "seq", c)
+        w2 = L.set(
+            w2, "seq", jnp.where(newer, seq2, L.get(words, "seq", c)), c
+        )
+        w2 = L.set(
+            w2, "val", jnp.where(newer, val2, L.get(words, "val", c)), c
+        )
+        w2, dup = self._net_send(w2, record_base + sv2)
+        o = o | (quorum & dup)
+        w = jnp.where(quorum, w2, w)
+        return w, ok, ok & o
+
+    def _body_record(self, words, e, prm):
+        """Record -> the peer: adopt newer pairs, always ack
+        (linearizable-register.rs:177-184)."""
+        import jax.numpy as jnp
+
+        L, u32 = self._layout, jnp.uint32
+        d, sv, ackrecord_code = prm[0], prm[1], prm[2]
+        deliv, w = self._net_take(words, e)
+        seq = sv // u32(self.NV)
+        newer = seq > L.get(words, "seq", d)
+        w = L.set(w, "seq", jnp.where(newer, seq, L.get(words, "seq", d)), d)
+        w = L.set(
+            w, "val", jnp.where(newer, sv % u32(self.NV), L.get(words, "val", d)), d
+        )
+        w, dup = self._net_send(w, ackrecord_code)
+        return w, deliv, deliv & dup
+
+    def _body_ackrecord(self, words, e, prm):
+        """AckRecord -> the coordinator: on an ack quorum answer the client
+        and clear the phase (linearizable-register.rs:185-210)."""
+        import jax.numpy as jnp
+
+        L, S, u32 = self._layout, self.S, jnp.uint32
+        c, rb, p, putok_code, getok_base = prm[0], prm[1], prm[2], prm[3], prm[4]
+        deliv, w = self._net_take(words, e)
+        ok = (
+            deliv
+            & (L.get(words, "kind", c) == 2)
+            & (L.get(words, "p_req", c) == rb)
+            & (L.get(words, "ak", c * S + p) == 0)
+        )
+        w = L.set(w, "ak", 1, c * S + p)
+        count = u32(0)
+        for j in range(S):
+            count = count + jnp.where(
+                p == u32(j), u32(1), L.get(words, "ak", c * S + j)
+            )
+        quorum = count == u32(self.majority)
+        read = L.get(words, "read", c)
+        w2 = w
+        for j in range(S):  # clear the phase
+            w2 = L.set(w2, "ak", 0, c * S + j)
+        w2 = L.set(w2, "kind", 0, c)
+        w2 = L.set(w2, "p_req", 0, c)
+        w2 = L.set(w2, "read", 0, c)
+        is_read = rb == 1
+        reply = jnp.where(is_read, getok_base + read - u32(1), putok_code)
+        w2, dup = self._net_send(w2, reply)
+        # A read phase always recorded a read value (read != 0).
+        o = quorum & (dup | (is_read & (read == 0)))
+        w = jnp.where(quorum, w2, w)
+        return w, ok, ok & o
+
+    def packed_properties(self, words):
+        """[conservative linearizable, value chosen] — order of
+        ``properties()``. The second mirrors ``value_chosen_condition``:
+        some deliverable GetOk with a real (non-None) value."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        lin_conservative = self._hist.valid_with_no_return_geq(words, 1)
+        chosen = jnp.bool_(False)
+        for k in range(self.C):
+            for v in range(1, self.NV):  # written values only
+                chosen = chosen | (L.get(words, "net", self._base_getok[k] + v) != 0)
+        return jnp.stack([lin_conservative, chosen])
 
 
 def main(argv=None) -> None:

@@ -201,7 +201,7 @@ def paxos_model(
     )
 
 
-class PackedPaxos(PackedModelAdapter):
+class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
     """Single Decree Paxos on the device engine (``spawn_xla``) — the
     flagship actor example packed into fixed-width state words.
 
@@ -402,8 +402,7 @@ class PackedPaxos(PackedModelAdapter):
         b.array("pp", S * S, 1)  # prepares presence, index s*S + key
         b.array("pv", S * S, _bits(self.NA - 1))  # prepares accepted-codes
         b.array("ac", S * S, 1)  # accepts bitset, index s*S + voter
-        b.array("cl_await", C, 2)
-        b.array("cl_ops", C, 2)
+        self._client_layout(b)
         b.array("net", self._U, 1)
         hist_values = [None] + self.values
         code_bits = _bits(len(hist_values))
@@ -452,7 +451,8 @@ class PackedPaxos(PackedModelAdapter):
                 return [d, self._base_getok[k]]
             if kind == "getok":
                 k, p = params
-                return [k, p]
+                # ReadOk(values[p]) ret code under [None]+values indexing.
+                return [k, 2 + p]
             if kind == "prepare":
                 l, r, d = params
                 return [
@@ -536,8 +536,6 @@ class PackedPaxos(PackedModelAdapter):
             "pp": [0] * (S * S),
             "pv": [0] * (S * S),
             "ac": [0] * (S * S),
-            "cl_await": [0] * C,
-            "cl_ops": [0] * C,
         }
         for s in range(S):
             a: PaxosState = state.actor_states[s]
@@ -559,18 +557,7 @@ class PackedPaxos(PackedModelAdapter):
                 fields["pv"][s * S + j] = self._acc_code(val)
             for j in a.accepts:
                 fields["ac"][s * S + int(j)] = 1
-        for k in range(C):
-            i = S + k
-            cs = state.actor_states[S + k]
-            if cs.awaiting is None:
-                fields["cl_await"][k] = 0
-            elif cs.awaiting == 1 * i:
-                fields["cl_await"][k] = 1
-            elif cs.awaiting == 2 * i:
-                fields["cl_await"][k] = 2
-            else:  # pragma: no cover - unreachable by construction
-                raise self._OverflowError32(f"unexpected request id {cs.awaiting}")
-            fields["cl_ops"][k] = cs.op_count
+        self._pack_clients(fields, state)
         net = [0] * self._U
         for env, count in state.network.counts.items():
             code = self._env_code.get(env)
@@ -615,12 +602,7 @@ class PackedPaxos(PackedModelAdapter):
                     is_decided=bool(f["dec"][s]),
                 )
             )
-        for k in range(C):
-            i = S + k
-            awaiting = {0: None, 1: 1 * i, 2: 2 * i}[f["cl_await"][k]]
-            actor_states.append(
-                reg.ClientState(awaiting=awaiting, op_count=f["cl_ops"][k])
-            )
+        self._unpack_clients(f, actor_states)
         counts = {
             self._envs[code]: count for code, count in enumerate(f["net"]) if count
         }
@@ -671,18 +653,6 @@ class PackedPaxos(PackedModelAdapter):
     # parameter row; returns (words'[W], valid, overflow). Pre-state reads
     # come from ``words``; updates accumulate on ``w``.
 
-    def _net_take(self, words, e):
-        """Consume the delivered envelope (non-duplicating, count 1)."""
-        L = self._layout
-        return L.get(words, "net", e) != 0, L.set(words, "net", 0, e)
-
-    def _net_send(self, w, idx):
-        """Set a presence bit; a double-send cannot be represented and
-        reports overflow (the loud-failure contract, SURVEY §7 #2)."""
-        L = self._layout
-        dup = L.get(w, "net", idx) != 0
-        return L.set(w, "net", 1, idx), dup
-
     def _body_put(self, words, e, prm):
         import jax.numpy as jnp
 
@@ -708,24 +678,6 @@ class PackedPaxos(PackedModelAdapter):
             o = o | dup
         return w, ok, ok & o
 
-    def _body_putok(self, words, e, prm):
-        import jax.numpy as jnp
-
-        L, u32 = self._layout, jnp.uint32
-        p, get_code = prm[0], prm[1]
-        deliv, w = self._net_take(words, e)
-        ok = deliv & (L.get(words, "cl_await", p) == u32(1))
-        w = L.set(w, "cl_await", 2, p)
-        w = L.set(w, "cl_ops", 2, p)
-        o = jnp.bool_(False)
-        for t in range(self.C):  # record WriteOk return + Read invocation
-            on = ok & (p == u32(t))
-            w, ot = self._hist.on_return(w, t, u32(0), enabled=on)
-            w = self._hist.on_invoke(w, t, u32(0), enabled=on)
-            o = o | ot
-        w, dup = self._net_send(w, get_code)
-        return w, ok, ok & (o | dup)
-
     def _body_get(self, words, e, prm):
         import jax.numpy as jnp
 
@@ -740,22 +692,6 @@ class PackedPaxos(PackedModelAdapter):
         # A decided server always has an accepted value (the ref
         # destructures it, paxos.rs:147); acc==0 here is a codec bug.
         return w, ok, ok & (dup | (acc_d == 0))
-
-    def _body_getok(self, words, e, prm):
-        import jax.numpy as jnp
-
-        L, u32 = self._layout, jnp.uint32
-        k, p = prm[0], prm[1]
-        deliv, w = self._net_take(words, e)
-        ok = deliv & (L.get(words, "cl_await", k) == u32(2))
-        w = L.set(w, "cl_await", 0, k)
-        w = L.set(w, "cl_ops", 3, k)
-        o = jnp.bool_(False)
-        for t in range(self.C):
-            # ReadOk(values[p]) ret code under [None]+values indexing.
-            w, ot = self._hist.on_return(w, t, u32(2) + p, enabled=ok & (k == u32(t)))
-            o = o | ot
-        return w, ok, ok & o
 
     def _body_prepare(self, words, e, prm):
         L = self._layout

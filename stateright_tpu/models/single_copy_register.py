@@ -68,7 +68,7 @@ def single_copy_register_model(
     )
 
 
-class PackedSingleCopyRegister(PackedModelAdapter):
+class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
     """The single-copy register on the device engine (``spawn_xla``) — the
     first packed model carrying a **consistency tester** in its state
     (SURVEY §7 M4 variant (a)).
@@ -128,13 +128,9 @@ class PackedSingleCopyRegister(PackedModelAdapter):
 
         value_bits = bits_for(V - 1)
         op_ret_bits = max(V.bit_length(), 2)
-        b = (
-            LayoutBuilder()
-            .array("srv", S, value_bits)
-            .array("cl_await", C, 2)
-            .array("cl_ops", C, 2)
-            .array("net", U, 2)
-        )
+        b = LayoutBuilder().array("srv", S, value_bits)
+        self._client_layout(b)
+        b.array("net", U, 2)
         self._hist = BoundedHistory(
             b,
             thread_ids=[Id(S + k) for k in range(C)],
@@ -175,19 +171,8 @@ class PackedSingleCopyRegister(PackedModelAdapter):
 
         S, C = self.S, self.C
         srv = [self.values.index(state.actor_states[s]) for s in range(S)]
-        cl_await, cl_ops = [], []
-        for k in range(C):
-            i = S + k
-            cs = state.actor_states[S + k]
-            if cs.awaiting is None:
-                cl_await.append(0)
-            elif cs.awaiting == 1 * i:
-                cl_await.append(1)
-            elif cs.awaiting == 2 * i:
-                cl_await.append(2)
-            else:  # pragma: no cover - unreachable by construction
-                raise self._OverflowError32(f"unexpected request id {cs.awaiting}")
-            cl_ops.append(cs.op_count)
+        fields = dict(srv=srv)
+        self._pack_clients(fields, state)
         net = [0] * self._U
         for env, count in state.network.counts.items():
             code = self._env_code.get(env)
@@ -196,7 +181,7 @@ class PackedSingleCopyRegister(PackedModelAdapter):
             if count > 3:
                 raise self._OverflowError32(f"envelope count {count} > 3: {env!r}")
             net[code] = count
-        fields = dict(srv=srv, cl_await=cl_await, cl_ops=cl_ops, net=net)
+        fields["net"] = net
         fields.update(self._hist.from_tester(state.history, self._op_code, self._ret_code))
         return self._layout.pack(**fields)
 
@@ -210,12 +195,7 @@ class PackedSingleCopyRegister(PackedModelAdapter):
         f = self._layout.unpack(words)
         S, C = self.S, self.C
         actor_states = [self.values[code] for code in f["srv"]]
-        for k in range(C):
-            i = S + k
-            awaiting = {0: None, 1: 1 * i, 2: 2 * i}[f["cl_await"][k]]
-            actor_states.append(
-                reg.ClientState(awaiting=awaiting, op_count=f["cl_ops"][k])
-            )
+        self._unpack_clients(f, actor_states)
         counts = {
             self._envs[code]: count for code, count in enumerate(f["net"]) if count
         }
