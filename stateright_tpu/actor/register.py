@@ -151,6 +151,17 @@ class PackedClientsMixin:
         b.array("cl_await", self.C, 2)
         b.array("cl_ops", self.C, 2)
 
+    def _client_values(self):
+        """The closed register-value universe: the unwritten ``None`` plus
+        each client's written value (client k writes chr('A'+k))."""
+        return [None] + [chr(ord("A") + k) for k in range(self.C)]
+
+    def _val_code(self, val) -> int:
+        try:
+            return self.values.index(val)
+        except ValueError:
+            raise self._OverflowError32(f"value outside universe: {val!r}")
+
     # --- host codec --------------------------------------------------------
 
     def _pack_clients(self, fields, state) -> None:

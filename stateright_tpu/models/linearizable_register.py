@@ -236,7 +236,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         self._OverflowError32 = OverflowError32
 
         #: values[0] is the unwritten None; client k writes values[1+k].
-        self.values = [None] + [chr(ord("A") + k) for k in range(C)]
+        self.values = self._client_values()
         NV = len(self.values)
         self.NV = NV
         #: seq codes, monotone in the model's (clock, Id) order:
@@ -384,12 +384,6 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
             return self._seqs.index(seq)
         except ValueError:
             raise self._OverflowError32(f"sequencer outside universe: {seq!r}")
-
-    def _val_code(self, val) -> int:
-        try:
-            return self.values.index(val)
-        except ValueError:
-            raise self._OverflowError32(f"value outside universe: {val!r}")
 
     def _sv_code(self, seq, val) -> int:
         return self._seq_code(seq) * self.NV + self._val_code(val)
@@ -577,11 +571,6 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         )
 
     # --- device kernels -----------------------------------------------------
-
-    def packed_init(self):
-        import numpy as np
-
-        return np.stack([self.pack(s) for s in self._inner.init_states()])
 
     def packed_step(self, words):
         """Full action fan-out, one vectorized body per ABD message family

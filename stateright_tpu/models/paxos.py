@@ -621,11 +621,6 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
 
     # --- device kernels -----------------------------------------------------
 
-    def packed_init(self):
-        import numpy as np
-
-        return np.stack([self.pack(s) for s in self._inner.init_states()])
-
     def packed_step(self, words):
         """Full action fan-out: deliver each universe envelope, dispatched
         on its protocol role (paxos.rs:110-248). One traced body per message

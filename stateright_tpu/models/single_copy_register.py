@@ -106,7 +106,7 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         self._inner = single_copy_register_model(client_count, server_count)
         S, C = server_count, client_count
         self.S, self.C = S, C
-        self.values = [None] + [chr(ord("A") + k) for k in range(C)]
+        self.values = self._client_values()
         V = len(self.values)
         self.V = V
 
@@ -214,11 +214,6 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
 
     # --- device kernels -----------------------------------------------------
 
-    def packed_init(self):
-        import numpy as np
-
-        return np.stack([self.pack(s) for s in self._inner.init_states()])
-
     def _net_dec(self, words, code):
         L = self._layout
         return L.set(words, "net", L.get(words, "net", code) - 1, code)
@@ -310,6 +305,252 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         for k in range(self.C):
             for vi in range(1, self.V):  # real (written) values only
                 chosen = chosen | (L.get(words, "net", k * self._B + 3 + vi) > 0)
+        return jnp.stack([lin_conservative, chosen])
+
+
+class PackedSingleCopyRegisterOrdered(reg.PackedClientsMixin, PackedModelAdapter):
+    """The single-copy register over the **ordered** network on the device
+    engine: the packed form of per-directed-pair FIFO channels where only
+    flow heads are deliverable (network.rs:57-67, 221-293), encoded with
+    :class:`~stateright_tpu.packing.FifoLanes`.
+
+    One lane per directed flow: ``k`` = client k -> the server (codes
+    0 = Put, 1 = Get), ``C + k`` = server -> client k (codes 0 = PutOk,
+    1 + v = GetOk(values[v])). An action slot is a lane, not an envelope:
+    delivering pops the head; a head whose delivery is a no-op (a reply the
+    client is not awaiting) blocks its lane exactly like the object model's
+    head-of-channel-only rule. The reference has no exact-count oracle for
+    this configuration (its tests use unordered networks; ``bench.sh`` runs
+    the ordered config as a benchmark), so parity is engine-vs-engine:
+    differential action-level tests against this package's object
+    ``OrderedNetwork`` model.
+    """
+
+    host_verified_properties = frozenset({"linearizable"})
+
+    def __init__(self, client_count: int = 2):
+        from ..packing import (
+            BoundedHistory,
+            FifoLanes,
+            LayoutBuilder,
+            OverflowError32,
+            bits_for,
+        )
+
+        C, S = client_count, 1
+        self.C, self.S = C, S
+        self._inner = single_copy_register_model(C, S, Network.new_ordered())
+        self._OverflowError32 = OverflowError32
+        self.values = self._client_values()
+        NV = len(self.values)
+        self.NV = NV
+        self.max_actions = 2 * C  # one action slot per lane
+
+        b = LayoutBuilder()
+        b.array("srv", S, bits_for(NV - 1))
+        self._client_layout(b)
+        # Lane k: client k -> server; lane C+k: server -> client k. Depth 2
+        # is headroom: the Put/Get script keeps at most one message in
+        # flight per direction (overflow reports loudly regardless).
+        self._lanes = FifoLanes(b, "flows", lanes=2 * C, depth=2, code_bits=bits_for(NV))
+        code_bits = bits_for(NV)
+        self._hist = BoundedHistory(
+            b,
+            thread_ids=[Id(S + k) for k in range(C)],
+            max_ops=2,
+            op_bits=code_bits,
+            ret_bits=code_bits,
+        )
+        self._layout = b.finish()
+        self._hist.bind(self._layout)
+        self._lanes.bind(self._layout)
+        self.state_words = self._layout.words
+
+        codecs = reg.history_codecs(self.values)
+        self._op_code, self._code_op, self._ret_code, self._code_ret = codecs
+
+    # --- lane codec ---------------------------------------------------------
+
+    def _lane_key(self, lane: int):
+        C, S = self.C, self.S
+        if lane < C:
+            return (Id(S + lane), Id(0))
+        return (Id(0), Id(S + (lane - C)))
+
+    def _msg_code(self, lane: int, msg) -> int:
+        k = lane if lane < self.C else lane - self.C
+        i = self.S + k
+        if lane < self.C:  # client -> server
+            if isinstance(msg, reg.Put) and msg == reg.Put(i, self.values[1 + k]):
+                return 0
+            if isinstance(msg, reg.Get) and msg == reg.Get(2 * i):
+                return 1
+        else:  # server -> client
+            if isinstance(msg, reg.PutOk) and msg == reg.PutOk(i):
+                return 0
+            if isinstance(msg, reg.GetOk) and msg.request_id == 2 * i:
+                return 1 + self._val_code(msg.value)
+        raise self._OverflowError32(f"message outside universe on lane {lane}: {msg!r}")
+
+    def _code_msg(self, lane: int, code: int):
+        k = lane if lane < self.C else lane - self.C
+        i = self.S + k
+        if lane < self.C:
+            return reg.Put(i, self.values[1 + k]) if code == 0 else reg.Get(2 * i)
+        if code == 0:
+            return reg.PutOk(i)
+        return reg.GetOk(2 * i, self.values[code - 1])
+
+    # --- codec -------------------------------------------------------------
+
+    def pack(self, state):
+        C = self.C
+        fields: dict = {"srv": [self._val_code(state.actor_states[0])]}
+        self._pack_clients(fields, state)
+        cells = [0] * (2 * C * self._lanes.depth)
+        lens = [0] * (2 * C)
+        flows = dict(state.network.flows)
+        for lane in range(2 * C):
+            msgs = flows.pop(self._lane_key(lane), ())
+            lane_cells, n = self._lanes.host_pack_lane(
+                [self._msg_code(lane, m) for m in msgs]
+            )
+            cells[lane * self._lanes.depth : (lane + 1) * self._lanes.depth] = lane_cells
+            lens[lane] = n
+        if flows:
+            raise self._OverflowError32(f"flows outside universe: {list(flows)!r}")
+        fields["flows_cells"] = cells
+        fields["flows_lens"] = lens
+        fields.update(
+            self._hist.from_tester(state.history, self._op_code, self._ret_code)
+        )
+        return self._layout.pack(**fields)
+
+    def unpack(self, words):
+        from ..actor.model_state import ActorModelState
+        from ..actor.network import OrderedNetwork
+        from ..actor.timers import Timers
+        from ..semantics import LinearizabilityTester
+        from ..semantics.register import Register
+
+        f = self._layout.unpack(words)
+        C, S = self.C, self.S
+        actor_states = [self.values[f["srv"][0]]]
+        self._unpack_clients(f, actor_states)
+        flows = {}
+        for lane in range(2 * C):
+            n = f["flows_lens"][lane]
+            cells = f["flows_cells"][
+                lane * self._lanes.depth : lane * self._lanes.depth + n
+            ]
+            if n:
+                flows[self._lane_key(lane)] = tuple(
+                    self._code_msg(lane, c - 1) for c in cells
+                )
+        history = self._hist.to_tester(
+            f,
+            lambda: LinearizabilityTester(Register(None)),
+            self._code_op,
+            self._code_ret,
+        )
+        return ActorModelState(
+            actor_states=tuple(actor_states),
+            network=OrderedNetwork(flows),
+            timers_set=tuple(Timers() for _ in range(S + C)),
+            history=history,
+        )
+
+    # --- device kernels -----------------------------------------------------
+
+    def packed_step(self, words):
+        """One action slot per lane: deliver its head (or mask the slot
+        invalid when the lane is empty / the head's delivery is a no-op)."""
+        import jax
+        import jax.numpy as jnp
+
+        C = self.C
+        to_server = jax.vmap(self._body_to_server, in_axes=(None, 0, 0))(
+            words,
+            jnp.arange(C, dtype=jnp.uint32),
+            jnp.asarray([[k, C + k] for k in range(C)], jnp.uint32),
+        )
+        to_client = jax.vmap(self._body_to_client, in_axes=(None, 0, 0))(
+            words,
+            jnp.arange(C, dtype=jnp.uint32),
+            jnp.asarray([[C + k, k] for k in range(C)], jnp.uint32),
+        )
+        nxt = jnp.concatenate([to_server[0], to_client[0]])
+        valid = jnp.concatenate([to_server[1], to_client[1]])
+        ovf = jnp.concatenate([to_server[2], to_client[2]])
+        return nxt, valid, ovf & valid
+
+    def _body_to_server(self, words, k, prm):
+        """Head of client k's lane -> the server: Put stores the value and
+        acks; Get replies with the current value (single-copy-register.rs:
+        18-46). Always valid when nonempty — the server never no-ops."""
+        import jax.numpy as jnp
+
+        L, u32 = self._layout, jnp.uint32
+        lane, reply_lane = prm[0], prm[1]
+        code, nonempty = self._lanes.head(words, lane)
+        w = self._lanes.pop(words, lane, enabled=nonempty)
+        is_put = code == 0
+        srv_val = L.get(words, "srv", 0)
+        w = L.set(w, "srv", jnp.where(is_put & nonempty, k + u32(1), srv_val), 0)
+        push_code = jnp.where(is_put, u32(0), u32(1) + srv_val)
+        w, ovf = self._lanes.push(w, reply_lane, push_code, enabled=nonempty)
+        return w, nonempty, nonempty & ovf
+
+    def _body_to_client(self, words, k, prm):
+        """Head of the server's lane -> client k: PutOk advances the script
+        (record WriteOk, invoke Read, send Get); GetOk completes it. A reply
+        the client is not awaiting is a no-op and BLOCKS the lane — the
+        packed form of head-of-channel-only delivery."""
+        import jax.numpy as jnp
+
+        L, u32 = self._layout, jnp.uint32
+        lane, req_lane = prm[0], prm[1]
+        code, nonempty = self._lanes.head(words, lane)
+        is_putok = code == 0
+        await_k = L.get(words, "cl_await", k)
+        eligible = nonempty & jnp.where(is_putok, await_k == u32(1), await_k == u32(2))
+        w = self._lanes.pop(words, lane, enabled=eligible)
+        w = L.set(
+            w,
+            "cl_await",
+            jnp.where(eligible, jnp.where(is_putok, u32(2), u32(0)), await_k),
+            k,
+        )
+        ops_k = L.get(words, "cl_ops", k)
+        w = L.set(
+            w,
+            "cl_ops",
+            jnp.where(eligible, jnp.where(is_putok, u32(2), u32(3)), ops_k),
+            k,
+        )
+        o = jnp.bool_(False)
+        for t in range(self.C):
+            on_p = eligible & is_putok & (k == u32(t))
+            w, o1 = self._hist.on_return(w, t, u32(0), enabled=on_p)  # WriteOk
+            w = self._hist.on_invoke(w, t, u32(0), enabled=on_p)  # Read
+            # GetOk(values[v]) lane code 1+v IS the ReadOk ret code.
+            on_g = eligible & ~is_putok & (k == u32(t))
+            w, o2 = self._hist.on_return(w, t, code, enabled=on_g)
+            o = o | o1 | o2
+        w, povf = self._lanes.push(w, req_lane, 1, enabled=eligible & is_putok)
+        return w, eligible, eligible & (o | povf)
+
+    def packed_properties(self, words):
+        """[conservative linearizable, value chosen]; "chosen" checks lane
+        HEADS only — under ordered semantics only heads are deliverable
+        (value_chosen_condition over iter_deliverable, network.rs:275-277)."""
+        import jax.numpy as jnp
+
+        lin_conservative = self._hist.valid_with_no_return_geq(words, 1)
+        chosen = jnp.bool_(False)
+        for k in range(self.C):
+            code, nonempty = self._lanes.head(words, self.C + k)
+            chosen = chosen | (nonempty & (code >= jnp.uint32(2)))
         return jnp.stack([lin_conservative, chosen])
 
 

@@ -58,6 +58,12 @@ class PackedModelAdapter:
 
         return CheckerBuilder(self)
 
+    def packed_init(self):
+        """Packed initial states: the inner model's, through ``pack``."""
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self._inner.init_states()])
+
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
