@@ -74,12 +74,27 @@ def _tpu_available(timeout_s: int) -> bool:
     return proc.returncode == 0 and "ok" in proc.stdout
 
 
-def _run_check(model, detail: list | None, **spawn_kwargs):
-    """One full-coverage check; returns (generated_states, seconds, checker)."""
+def _run_check(model, detail: list | None, budget_s: float = float("inf"), **spawn_kwargs):
+    """A check bounded by wall-clock ``budget_s``: runs whole BFS levels
+    until done or out of budget; returns (generated_states, seconds,
+    checker, completed).
+
+    The budget is what makes the bench un-hangable: the states/sec metric
+    only needs steady-state levels, not full coverage, so an arbitrarily
+    large ``BENCH_RM`` space still yields a number in bounded time (the
+    round-1/2 failure mode was a warm pass chasing full coverage for the
+    driver's whole time limit)."""
     checker = model.checker().spawn_xla(**spawn_kwargs)
     t0 = time.monotonic()
     states0 = checker.state_count()
     while not checker.is_done():
+        if time.monotonic() - t0 > budget_s:
+            _log(
+                f"budget {budget_s:.0f}s exhausted at depth {checker._depth} "
+                f"({checker.state_count() - states0} states generated); "
+                "reporting partial-coverage throughput"
+            )
+            break
         lvl_t0 = time.monotonic()
         width = checker._frontier_count
         checker._run_block()
@@ -92,8 +107,10 @@ def _run_check(model, detail: list | None, **spawn_kwargs):
                 }
             )
     elapsed = time.monotonic() - t0
-    checker.assert_properties()
-    return checker.state_count() - states0, elapsed, checker
+    completed = checker.is_done()
+    if completed:
+        checker.assert_properties()
+    return checker.state_count() - states0, elapsed, checker, completed
 
 
 def _run_matrix(platform: str) -> list:
@@ -132,12 +149,20 @@ def _run_matrix(platform: str) -> list:
         ),
     ]:
         try:
+            budget = float(os.environ.get("BENCH_MATRIX_BUDGET_S", "300"))
             model = build()
             t0 = time.monotonic()
-            _run_check(model, None, **kwargs)  # warm: compiles
+            _run_check(model, None, budget_s=budget, **kwargs)  # warm: compiles
             warm = time.monotonic() - t0
-            states, sec, checker = _run_check(model, None, **kwargs)
-            checker.assert_properties()
+            states, sec, checker, done = _run_check(
+                model, None, budget_s=budget, **kwargs
+            )
+            if not done:
+                rows.append(
+                    {"config": name, "error": f"budget {budget:.0f}s exhausted"}
+                )
+                _log(f"matrix {name}: budget exhausted")
+                continue
             rows.append(
                 {
                     "config": name,
@@ -197,19 +222,26 @@ def main() -> None:
     model = PackedTwoPhaseSys(rm)
 
     # Pass 1: warm every superstep bucket (compile time, excluded).
+    warm_budget = float(os.environ.get("BENCH_WARM_BUDGET_S", "600"))
+    measure_budget = float(os.environ.get("BENCH_MEASURE_BUDGET_S", "300"))
     spawn_kwargs = dict(
         frontier_capacity=1 << frontier_pow, table_capacity=1 << table_pow
     )
-    warm_states, warm_sec, _ = _run_check(model, None, **spawn_kwargs)
+    warm_states, warm_sec, _, _ = _run_check(
+        model, None, budget_s=warm_budget, **spawn_kwargs
+    )
     _log(f"warm pass: {warm_states} states in {warm_sec:.2f}s (compile included)")
 
     # Pass 2: measured steady-state run.
     detail: list = []
-    states, elapsed, checker = _run_check(model, detail, **spawn_kwargs)
+    states, elapsed, checker, completed = _run_check(
+        model, detail, budget_s=measure_budget, **spawn_kwargs
+    )
     value = states / max(elapsed, 1e-9)
     _log(
         f"measured pass: {states} states ({checker.unique_state_count()} unique, "
-        f"depth {checker.max_depth()}) in {elapsed:.2f}s -> {value:,.0f} states/s"
+        f"depth {checker.max_depth()}, {'full' if completed else 'partial'} "
+        f"coverage) in {elapsed:.2f}s -> {value:,.0f} states/s"
     )
 
     matrix = []
@@ -230,6 +262,7 @@ def main() -> None:
                 "max_depth": checker.max_depth(),
                 "warm_pass_sec": round(warm_sec, 3),
                 "measured_sec": round(elapsed, 3),
+                "full_coverage": completed,
                 "states_per_sec": round(value, 1),
                 "levels": detail,
                 "matrix": matrix,

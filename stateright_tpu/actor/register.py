@@ -190,10 +190,75 @@ class PackedClientsMixin:
                 ClientState(awaiting=awaiting, op_count=f["cl_ops"][k])
             )
 
+    # --- family machinery --------------------------------------------------
+    # Models enumerate a closed envelope universe into self._handlers
+    # [(kind, static params)] in code order; these helpers group contiguous
+    # same-kind runs into (kind, codes, param-table) families and run one
+    # vmapped traced body per family — trace size (and XLA compile time)
+    # stays constant in the universe size.
+
+    def _group_families(self, params_for):
+        """Group ``self._handlers`` into families with uint32 param tables
+        built by ``params_for(kind, params) -> list[int]``."""
+        import numpy as np
+
+        families = []
+        start = 0
+        while start < self._U:
+            kind = self._handlers[start][0]
+            end = start
+            while end < self._U and self._handlers[end][0] == kind:
+                end += 1
+            rows = [
+                params_for(kind, self._handlers[e][1]) for e in range(start, end)
+            ]
+            families.append(
+                (
+                    kind,
+                    np.arange(start, end, dtype=np.uint32),
+                    np.asarray(rows, dtype=np.uint32),
+                )
+            )
+            start = end
+        return families
+
+    def packed_step(self, words):
+        """Full action fan-out: deliver each universe envelope via its
+        family's ``_body_<kind>`` method, vmapped over the parameter table."""
+        import jax
+        import jax.numpy as jnp
+
+        nxts, valids, ovfs = [], [], []
+        for kind, codes, prm in self._families:
+            body = getattr(self, "_body_" + kind)
+            nxt, valid, ovf = jax.vmap(body, in_axes=(None, 0, 0))(
+                words, jnp.asarray(codes), jnp.asarray(prm)
+            )
+            nxts.append(nxt)
+            valids.append(valid)
+            ovfs.append(ovf)
+        valid = jnp.concatenate(valids)
+        return jnp.concatenate(nxts), valid, jnp.concatenate(ovfs) & valid
+
     # --- presence-bit network helpers --------------------------------------
     # The universe's non-duplicating multiset packs as a "net" 1-bit array
     # (empirically every register protocol here keeps counts at 1; a double
     # send cannot be represented and reports overflow, SURVEY §7 #2).
+
+    def _pack_presence_net(self, fields, state) -> None:
+        """Pack ``state.network.counts`` as presence bits; leaving the
+        universe or exceeding count 1 fails loudly."""
+        net = [0] * self._U
+        for env, count in state.network.counts.items():
+            code = self._env_code.get(env)
+            if code is None:
+                raise self._OverflowError32(f"envelope outside universe: {env!r}")
+            if count > 1:
+                raise self._OverflowError32(
+                    f"envelope count {count} > 1 (presence-bit codec): {env!r}"
+                )
+            net[code] = count
+        fields["net"] = net
 
     def _net_take(self, words, e):
         """Consume the delivered envelope; returns (was-present, words')."""

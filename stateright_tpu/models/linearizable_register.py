@@ -399,8 +399,6 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         return rb
 
     def _build_families(self):
-        import numpy as np
-
         def params_for(kind: str, params) -> list:
             if kind == "begin":
                 c, rb = params
@@ -426,23 +424,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
             getok_base = self._base_getok[(c + 1) % self.S] if rb == 1 else 0
             return [c, rb, p, putok, getok_base]
 
-        families = []
-        start = 0
-        while start < self._U:
-            kind = self._handlers[start][0]
-            end = start
-            while end < self._U and self._handlers[end][0] == kind:
-                end += 1
-            rows = [params_for(kind, self._handlers[e][1]) for e in range(start, end)]
-            families.append(
-                (
-                    kind,
-                    np.arange(start, end, dtype=np.uint32),
-                    np.asarray(rows, dtype=np.uint32),
-                )
-            )
-            start = end
-        return families
+        return self._group_families(params_for)
 
     # --- codec -------------------------------------------------------------
 
@@ -488,17 +470,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
             elif a.phase is not None:  # pragma: no cover
                 raise self._OverflowError32(f"unknown phase {a.phase!r}")
         self._pack_clients(fields, state)
-        net = [0] * self._U
-        for env, count in state.network.counts.items():
-            code = self._env_code.get(env)
-            if code is None:
-                raise self._OverflowError32(f"envelope outside universe: {env!r}")
-            if count > 1:
-                raise self._OverflowError32(
-                    f"envelope count {count} > 1 (presence-bit codec): {env!r}"
-                )
-            net[code] = count
-        fields["net"] = net
+        self._pack_presence_net(fields, state)
         fields.update(
             self._hist.from_tester(state.history, self._op_code, self._ret_code)
         )
@@ -571,24 +543,6 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         )
 
     # --- device kernels -----------------------------------------------------
-
-    def packed_step(self, words):
-        """Full action fan-out, one vectorized body per ABD message family
-        (linearizable-register.rs:82-210)."""
-        import jax
-        import jax.numpy as jnp
-
-        nxts, valids, ovfs = [], [], []
-        for kind, codes, prm in self._families:
-            body = getattr(self, "_body_" + kind)
-            nxt, valid, ovf = jax.vmap(body, in_axes=(None, 0, 0))(
-                words, jnp.asarray(codes), jnp.asarray(prm)
-            )
-            nxts.append(nxt)
-            valids.append(valid)
-            ovfs.append(ovf)
-        valid = jnp.concatenate(valids)
-        return jnp.concatenate(nxts), valid, jnp.concatenate(ovfs) & valid
 
     def _body_begin(self, words, e, prm):
         """Put/Get -> its coordinator: begin phase 1 seeded with the local

@@ -426,12 +426,9 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
         return [j for j in range(self.S) if j != x]
 
     def _build_families(self):
-        """Group the universe into contiguous same-kind families and build
-        their uint32 parameter tables (one column per static handler input,
-        send-base columns per peer). ``packed_step`` vmaps one traced body
-        per kind over these tables."""
-        import numpy as np
-
+        """Per-family uint32 parameter tables (one column per static
+        handler input, send-base columns per peer); see
+        PackedClientsMixin._group_families/packed_step."""
         C = self.C
 
         def acc_base(l: int, r: int) -> int:
@@ -489,23 +486,7 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
             l, r, d, p = params
             return [self._ballot_code((r, Id(l))), d, acc_base(l, r) + p]
 
-        families = []
-        start = 0
-        while start < self._U:
-            kind = self._handlers[start][0]
-            end = start
-            while end < self._U and self._handlers[end][0] == kind:
-                end += 1
-            rows = [params_for(kind, self._handlers[e][1]) for e in range(start, end)]
-            families.append(
-                (
-                    kind,
-                    np.arange(start, end, dtype=np.uint32),
-                    np.asarray(rows, dtype=np.uint32),
-                )
-            )
-            start = end
-        return families
+        return self._group_families(params_for)
 
     def _proposal(self, p: int):
         return (self.S + p, Id(self.S + p), self.values[p])
@@ -558,17 +539,7 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
             for j in a.accepts:
                 fields["ac"][s * S + int(j)] = 1
         self._pack_clients(fields, state)
-        net = [0] * self._U
-        for env, count in state.network.counts.items():
-            code = self._env_code.get(env)
-            if code is None:
-                raise self._OverflowError32(f"envelope outside universe: {env!r}")
-            if count > 1:
-                raise self._OverflowError32(
-                    f"envelope count {count} > 1 (presence-bit codec): {env!r}"
-                )
-            net[code] = count
-        fields["net"] = net
+        self._pack_presence_net(fields, state)
         fields.update(
             self._hist.from_tester(state.history, self._op_code, self._ret_code)
         )
@@ -620,28 +591,6 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
         )
 
     # --- device kernels -----------------------------------------------------
-
-    def packed_step(self, words):
-        """Full action fan-out: deliver each universe envelope, dispatched
-        on its protocol role (paxos.rs:110-248). One traced body per message
-        family, vmapped over the family's parameter table — trace size (and
-        XLA compile time) is constant in the universe size. No-op deliveries
-        (ballot/quorum/script mismatches, model.rs:286-289) are masked
-        invalid; universe departures surface on the overflow output."""
-        import jax
-        import jax.numpy as jnp
-
-        nxts, valids, ovfs = [], [], []
-        for kind, codes, prm in self._families:
-            body = getattr(self, "_body_" + kind)
-            nxt, valid, ovf = jax.vmap(body, in_axes=(None, 0, 0))(
-                words, jnp.asarray(codes), jnp.asarray(prm)
-            )
-            nxts.append(nxt)
-            valids.append(valid)
-            ovfs.append(ovf)
-        valid = jnp.concatenate(valids)
-        return jnp.concatenate(nxts), valid, jnp.concatenate(ovfs) & valid
 
     # --- vectorized per-family delivery bodies -----------------------------
     # Each takes (words[W], e, prm[cols]) with traced envelope code and

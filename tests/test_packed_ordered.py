@@ -13,7 +13,6 @@ import random
 import numpy as np
 
 from stateright_tpu.actor import Network
-from stateright_tpu.actor.network import Envelope
 from stateright_tpu.models.single_copy_register import (
     PackedSingleCopyRegisterOrdered,
     single_copy_register_model,
