@@ -218,8 +218,6 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
     single peer); wider clusters model-check on the host engines.
     """
 
-    host_verified_properties = frozenset({"linearizable"})
-
     def __init__(self, client_count: int = 2, server_count: int = 2):
         from ..actor.network import Envelope
         from ..packing import BoundedHistory, LayoutBuilder, OverflowError32, bits_for
@@ -699,12 +697,12 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         import jax.numpy as jnp
 
         L = self._layout
-        lin_conservative = self._hist.valid_with_no_return_geq(words, 1)
+        lin = self.device_linearizable_register(words)
         chosen = jnp.bool_(False)
         for k in range(self.C):
             for v in range(1, self.NV):  # written values only
                 chosen = chosen | (L.get(words, "net", self._base_getok[k] + v) != 0)
-        return jnp.stack([lin_conservative, chosen])
+        return jnp.stack([lin, chosen])
 
 
 def main(argv=None) -> None:

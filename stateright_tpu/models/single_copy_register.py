@@ -96,13 +96,16 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
     (single-copy-register.rs:136).
     """
 
-    host_verified_properties = frozenset({"linearizable"})
-
     def __init__(self, client_count: int = 2, server_count: int = 1):
         from ..actor.network import Envelope
         from ..packing import BoundedHistory, LayoutBuilder, OverflowError32
         from ..semantics.register import Read, ReadOk, Write, WriteOk
 
+        if client_count != 2:
+            raise ValueError(
+                "the packed model's exact device linearizability covers the "
+                "2-client shape; other sizes run on the host engines"
+            )
         self._inner = single_copy_register_model(client_count, server_count)
         S, C = server_count, client_count
         self.S, self.C = S, C
@@ -298,14 +301,13 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         import jax.numpy as jnp
 
         L = self._layout
-        # ReadOk ret codes are >= 1 under this model's coding (WriteOk = 0).
-        lin_conservative = self._hist.valid_with_no_return_geq(words, 1)
+        lin = self.device_linearizable_register(words)
 
         chosen = jnp.bool_(False)
         for k in range(self.C):
             for vi in range(1, self.V):  # real (written) values only
                 chosen = chosen | (L.get(words, "net", k * self._B + 3 + vi) > 0)
-        return jnp.stack([lin_conservative, chosen])
+        return jnp.stack([lin, chosen])
 
 
 class PackedSingleCopyRegisterOrdered(reg.PackedClientsMixin, PackedModelAdapter):
@@ -326,8 +328,6 @@ class PackedSingleCopyRegisterOrdered(reg.PackedClientsMixin, PackedModelAdapter
     ``OrderedNetwork`` model.
     """
 
-    host_verified_properties = frozenset({"linearizable"})
-
     def __init__(self, client_count: int = 2):
         from ..packing import (
             BoundedHistory,
@@ -337,6 +337,11 @@ class PackedSingleCopyRegisterOrdered(reg.PackedClientsMixin, PackedModelAdapter
             bits_for,
         )
 
+        if client_count != 2:
+            raise ValueError(
+                "the packed model's exact device linearizability covers the "
+                "2-client shape; other sizes run on the host engines"
+            )
         C, S = client_count, 1
         self.C, self.S = C, S
         self._inner = single_copy_register_model(C, S, Network.new_ordered())
@@ -546,12 +551,12 @@ class PackedSingleCopyRegisterOrdered(reg.PackedClientsMixin, PackedModelAdapter
         (value_chosen_condition over iter_deliverable, network.rs:275-277)."""
         import jax.numpy as jnp
 
-        lin_conservative = self._hist.valid_with_no_return_geq(words, 1)
+        lin = self.device_linearizable_register(words)
         chosen = jnp.bool_(False)
         for k in range(self.C):
             code, nonempty = self._lanes.head(words, self.C + k)
             chosen = chosen | (nonempty & (code >= jnp.uint32(2)))
-        return jnp.stack([lin_conservative, chosen])
+        return jnp.stack([lin, chosen])
 
 
 def main(argv=None) -> None:

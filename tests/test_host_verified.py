@@ -1,0 +1,50 @@
+"""The host-verified property machinery, exercised on its own.
+
+The shipped register models now check linearizability EXACTLY on device
+(``device_linearizable_register``), so none of them routes through the
+engine's host-verification path anymore. That path remains part of the
+engine contract (``stateright_tpu.xla`` module docstring) for models whose
+exact conditions cannot run on device — e.g. histories too large for the
+static interleaving enumeration. This test pins it with a model variant
+that deliberately uses the conservative device predicate
+(``BoundedHistory.valid_with_no_return_geq``: "valid and no completed
+read", exact in one direction) and relies on the engine to confirm
+candidates with the exact backtracking serializer on the host.
+"""
+
+from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
+
+
+class ConservativeSingleCopy(PackedSingleCopyRegister):
+    """Single-copy register with the M4(a)-style conservative device
+    predicate + host verification, instead of the exact device check."""
+
+    host_verified_properties = frozenset({"linearizable"})
+
+    def packed_properties(self, words):
+        props = super().packed_properties(words)
+        # Certainly-linearizable iff unpoisoned with no completed read
+        # (ReadOk codes are >= 1); anything else becomes a host candidate.
+        return props.at[0].set(self._hist.valid_with_no_return_geq(words, 1))
+
+
+def test_host_verified_full_coverage_confirms_no_candidate():
+    """1 server: every flagged candidate passes the exact host check, so
+    full coverage completes with no discovery for the always-property."""
+    m = ConservativeSingleCopy(2, 1)
+    xc = m.checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 12, host_verified_cap=1024
+    ).join()
+    assert xc.unique_state_count() == 93  # single-copy-register.rs:110
+    xc.assert_properties()
+
+
+def test_host_verified_confirms_the_real_counterexample():
+    """2 servers: the host serializer must reject spuriously-flagged
+    candidates and confirm only a genuinely non-linearizable state."""
+    m = ConservativeSingleCopy(2, 2)
+    xc = m.checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 12, host_verified_cap=1024
+    ).join()
+    witness = xc.discoveries()["linearizable"]
+    assert witness.last_state().history.serialized_history() is None
