@@ -206,8 +206,8 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
     all counts stay at 1), per-message-family vectorized delivery bodies
     vmapped over parameter tables, and the ``LinearizabilityTester`` history
     carried via :class:`~stateright_tpu.packing.BoundedHistory` with the
-    ``linearizable`` property host-verified (conservative device predicate +
-    exact backtracking serializer on flagged candidates).
+    ``linearizable`` property checked exactly on device
+    (``device_linearizable_register``).
 
     Codec bounds (verified by full enumeration of the object model):
     logical clocks are bounded by the Put count (each Put bumps the max
@@ -691,7 +691,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         return w, ok, ok & o
 
     def packed_properties(self, words):
-        """[conservative linearizable, value chosen] — order of
+        """[linearizable, value chosen] — order of
         ``properties()``. The second mirrors ``value_chosen_condition``:
         some deliverable GetOk with a real (non-None) value."""
         import jax.numpy as jnp

@@ -228,13 +228,12 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
       :class:`~stateright_tpu.packing.BoundedHistory` (max 2 ops/client),
       exactly as the object model carries it (paxos.rs:266-292).
 
-    The ``linearizable`` property is host-verified (SURVEY §7 M4a): the
-    device flags any state whose history contains a completed read as a
-    candidate, and the engine re-checks candidates with the exact
-    backtracking serializer (linearizability.rs:197-284) before recording
-    a discovery. Use ``spawn_xla(host_verified_cap=4096)`` for full-coverage
-    runs: read-bearing levels are wide, and every candidate must be host
-    cleared (they all pass — Paxos is linearizable).
+    The ``linearizable`` property is checked EXACTLY on device
+    (``device_linearizable_register``, SURVEY §7 M4 variant (b)): the
+    bounded history these clients produce admits a static enumeration of
+    every interleaving the backtracking serializer
+    (linearizability.rs:197-284) would try, fused into the property pass —
+    no host re-verification step and no candidate-buffer sizing needed.
 
     Oracle: 16,668 unique states at 2 clients / 3 servers
     (paxos.rs:321,345), reproduced differentially against the object model.
@@ -739,12 +738,9 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
         return w, ok, ok & ~ok  # never overflows
 
     def packed_properties(self, words):
-        """[conservative linearizable, value chosen] — order of
-        ``properties()``. The first is the host-verified conservative
-        predicate: certainly linearizable iff the history is unpoisoned and
-        contains no completed read (completed-write-only histories always
-        admit a legal serialization for a register); any completed read
-        flags the state for exact host verification. The second mirrors
+        """[linearizable, value chosen] — order of ``properties()``. The
+        first is the EXACT on-device linearizability check
+        (``device_linearizable_register``). The second mirrors
         ``value_chosen_condition``: a deliverable GetOk with a real value —
         Paxos GetOks always carry one."""
         import jax.numpy as jnp

@@ -84,15 +84,13 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
     - the ``LinearizabilityTester`` history packs exactly via
       :class:`~stateright_tpu.packing.BoundedHistory` (2 ops/client).
 
-    The ``linearizable`` property is **host-verified**: the device runs a
-    conservative predicate (a history with no completed read — and no
-    protocol poison — is always linearizable for a register: completed
-    writes admit any real-time-respecting order), and the engine re-checks
-    flagged candidates with the exact backtracking serializer
-    (linearizability.rs:197-284) on the host before recording the
-    counterexample. With one server the model reaches full coverage (93
-    unique states, single-copy-register.rs:110); with two servers the
-    stale-read counterexample is confirmed on host
+    The ``linearizable`` property is checked EXACTLY on device
+    (``device_linearizable_register``, SURVEY §7 M4 variant (b)): the
+    bounded 2-client history admits a static enumeration of every
+    interleaving the backtracking serializer (linearizability.rs:197-284)
+    would try, fused into the property pass. With one server the model
+    reaches full coverage (93 unique states, single-copy-register.rs:110);
+    with two servers the stale-read counterexample is found on device
     (single-copy-register.rs:136).
     """
 
@@ -293,11 +291,9 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         return jnp.stack(nxt), valid, jnp.stack(ovf) & valid
 
     def packed_properties(self, words):
-        """[conservative linearizable, value chosen] — order of
-        ``properties()``. The first is the host-verified conservative
-        predicate: True (= certainly linearizable) iff the history is
-        unpoisoned and contains no completed read; completed-write-only
-        histories always admit a legal serialization for a register."""
+        """[linearizable, value chosen] — order of ``properties()``. The
+        first is the EXACT on-device linearizability check
+        (``device_linearizable_register``)."""
         import jax.numpy as jnp
 
         L = self._layout
@@ -546,7 +542,7 @@ class PackedSingleCopyRegisterOrdered(reg.PackedClientsMixin, PackedModelAdapter
         return w, eligible, eligible & (o | povf)
 
     def packed_properties(self, words):
-        """[conservative linearizable, value chosen]; "chosen" checks lane
+        """[linearizable, value chosen]; "chosen" checks lane
         HEADS only — under ordered semantics only heads are deliverable
         (value_chosen_condition over iter_deliverable, network.rs:275-277)."""
         import jax.numpy as jnp

@@ -98,6 +98,7 @@ class XlaChecker(Checker):
         max_probes: int = 32,
         host_verified_cap: int = 128,
         visit_cap: int = 4096,
+        levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
     ):
         import jax
@@ -149,6 +150,16 @@ class XlaChecker(Checker):
         self._hv_cap = host_verified_cap
         # Per-level ceiling on host-side visitor path reconstruction.
         self._visit_cap = visit_cap
+        # BFS levels fused into one device dispatch. Each host round-trip
+        # costs real latency (the axon TPU sits behind a tunnel), so the
+        # level loop runs *on device* in a ``lax.while_loop`` that exits
+        # early on frontier exhaustion, overflow, discovery resolution, or
+        # a state-count target — semantically identical to dispatching one
+        # level at a time, at level granularity. Visitors force 1 (they
+        # need the host between levels).
+        self._levels_per_dispatch = (
+            1 if self._visitor is not None else max(1, levels_per_dispatch)
+        )
 
         # --- device state ------------------------------------------------
         import jax.numpy as jnp
@@ -451,13 +462,148 @@ class XlaChecker(Checker):
                 hv_counts,
             )
 
-        return jax.jit(superstep)
+        return superstep
+
+    def _build_fused(self, f_cap: int):
+        """The level loop as a device program: a ``lax.while_loop`` around
+        the superstep that commits one BFS level per iteration and exits on
+        (a) the level budget, (b) frontier exhaustion, (c) any overflow —
+        the overflowing level is NOT committed, so the host can grow and
+        re-enter, (d) every property resolved (found on device, already
+        confirmed on host, or — for host-verified properties — at least one
+        candidate collected for the host to confirm), or (e) a state-count
+        target. Exit conditions are evaluated at level granularity, exactly
+        like the one-level-per-dispatch path; only the host round-trips
+        differ."""
+        import jax
+        import jax.numpy as jnp
+
+        superstep = self._build_superstep(f_cap)
+        W = self._W
+        n_hv = len(self._hv_idx)
+        hv_cap = self._hv_cap
+        # Map property index -> (is_hv, hv position) for the resolution mask.
+        hv_pos = {i: j for j, i in enumerate(self._hv_idx)}
+        P = self._P
+
+        def fused(frontier, f_ebits, f_count, table, disc_found, disc_fp,
+                  budget, remaining, host_found):
+            def resolved(disc_found, hv_cnt_acc):
+                if P == 0:
+                    return jnp.bool_(False)
+                per_prop = [
+                    host_found[i]
+                    | (hv_cnt_acc[hv_pos[i]] > 0 if i in hv_pos else disc_found[i])
+                    for i in range(P)
+                ]
+                return jnp.all(jnp.stack(per_prop))
+
+            def hv_pending(hv_cnt_acc):
+                """Any *unconfirmed* host-verified property with collected
+                candidates: the host must confirm before exploring further,
+                and exiting here keeps the candidate buffer to one level's
+                worth — the same ``hv_cap`` budget the one-level path has."""
+                if not n_hv:
+                    return jnp.bool_(False)
+                flags = [
+                    (hv_cnt_acc[j] > 0) & ~host_found[i] for i, j in hv_pos.items()
+                ]
+                return jnp.any(jnp.stack(flags))
+
+            def cond(carry):
+                (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
+                 disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c) = carry
+                return (
+                    (lvl < budget)
+                    & (f_count > 0)
+                    & ~jnp.any(ovf)
+                    & ~resolved(disc_found, hv_c)
+                    & ~hv_pending(hv_c)
+                    & (tot_states < remaining)
+                )
+
+            def body(carry):
+                (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
+                 disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c) = carry
+                (nf, ne, ncount, ntable, ndfound, ndfp, d_states, d_unique,
+                 t_ovf, f_ovf, c_ovf, lw, lf, lc) = superstep(
+                    frontier, f_ebits, f_count, table, disc_found, disc_fp
+                )
+                any_ovf = t_ovf | f_ovf | c_ovf
+                commit = ~any_ovf
+                sel = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(commit, a, b), new, old
+                )
+                # Append this level's host-verified candidates to the block
+                # accumulator (frontier order within a level, level order
+                # across the block — the confirmation order the one-level
+                # path uses).
+                if n_hv:
+                    rows = jnp.arange(hv_cap)
+                    for j in range(n_hv):
+                        dst = hv_c[j] + rows
+                        ok = (rows < lc[j]) & (dst < hv_cap)
+                        tgt = jnp.where(ok, dst, hv_cap)
+                        hv_w = hv_w.at[j].set(hv_w[j].at[tgt].set(lw[j], mode="drop"))
+                        hv_f = hv_f.at[j].set(hv_f[j].at[tgt].set(lf[j], mode="drop"))
+                    hv_c = sel(hv_c + lc, hv_c)
+                    hv_w = sel(hv_w, carry[11])
+                    hv_f = sel(hv_f, carry[12])
+                return (
+                    lvl + 1,
+                    committed + commit.astype(jnp.int32),
+                    sel(nf, frontier),
+                    sel(ne, f_ebits),
+                    sel(ncount, f_count),
+                    sel(ntable, table),
+                    sel(ndfound, disc_found),
+                    sel(ndfp, disc_fp),
+                    tot_states + jnp.where(commit, d_states, 0),
+                    tot_unique + jnp.where(commit, d_unique, 0),
+                    jnp.stack([t_ovf, f_ovf, c_ovf]),
+                    hv_w,
+                    hv_f,
+                    hv_c,
+                )
+
+            carry0 = (
+                jnp.int32(0),
+                jnp.int32(0),
+                frontier,
+                f_ebits,
+                f_count,
+                table,
+                disc_found,
+                disc_fp,
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.zeros((3,), jnp.bool_),
+                jnp.zeros((n_hv, hv_cap, W), jnp.uint32),
+                jnp.zeros((n_hv, hv_cap, 2), jnp.uint32),
+                jnp.zeros((n_hv,), jnp.int32),
+            )
+            out = jax.lax.while_loop(cond, body, carry0)
+            return out[1:]  # drop the raw level counter
+
+        return fused
 
     def _superstep_for(self, f_cap: int):
+        import jax
+
         key = (f_cap, self._symmetry, self._max_probes)
         fn = self._superstep_cache.get(key)
         if fn is None:
-            fn = self._build_superstep(f_cap)
+            fn = jax.jit(self._build_superstep(f_cap))
+            self._superstep_cache[key] = fn
+        return fn
+
+    def _fused_for(self, f_cap: int):
+        import jax
+
+        key = ("fused", f_cap, self._symmetry, self._max_probes)
+        fn = self._superstep_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._build_fused(f_cap))
             self._superstep_cache[key] = fn
         return fn
 
@@ -482,6 +628,24 @@ class XlaChecker(Checker):
             raise RuntimeError("rehash overflow — pathological fingerprint distribution")
         self._table = bigger
 
+    def _raise_codec_overflow(self) -> None:
+        raise RuntimeError(
+            f"{type(self._model).__name__}: packed-codec capacity "
+            "overflow — a reachable successor does not fit the "
+            "model's declared field widths/slot counts. Raise the "
+            "model's capacity bounds (this is the loud failure the "
+            "packed toolkit guarantees; see stateright_tpu.packing)."
+        )
+
+    def _grow_frontier(self, run_cap: int) -> int:
+        """Next bucket after a frontier-compaction overflow: the next
+        power-of-four bucket, or — past the top bucket — a doubled
+        frontier-capacity ceiling. Returns the new run capacity."""
+        if run_cap < self._frontier_capacity:
+            return min(run_cap * 4, self._frontier_capacity)
+        self._frontier_capacity *= 2
+        return self._frontier_capacity
+
     def _run_cap_for(self, n: int) -> int:
         """Smallest power-of-FOUR run capacity with ~4x expansion headroom
         over the live frontier, clamped to [1024, frontier_capacity].
@@ -493,7 +657,140 @@ class XlaChecker(Checker):
             cap *= 4
         return min(cap, self._frontier_capacity)
 
+    def _bucket_inputs(self, run_cap: int):
+        """Pad or slice the stored frontier to this dispatch's bucket."""
+        import jax
+        import jax.numpy as jnp
+
+        stored = self._frontier.shape[0]
+        if stored < run_cap:
+            f_in = jnp.concatenate(
+                [self._frontier, jnp.zeros((run_cap - stored, self._W), jnp.uint32)]
+            )
+            e_in = jnp.concatenate(
+                [self._frontier_ebits, jnp.zeros((run_cap - stored,), jnp.uint32)]
+            )
+        elif stored > run_cap:
+            f_in = jax.lax.slice_in_dim(self._frontier, 0, run_cap)
+            e_in = jax.lax.slice_in_dim(self._frontier_ebits, 0, run_cap)
+        else:
+            f_in, e_in = self._frontier, self._frontier_ebits
+        return f_in, e_in
+
+    def _pin_found_names(self) -> None:
+        """Records first-found witness fingerprints by property name."""
+        found = np.asarray(self._disc_found)
+        fps = np.asarray(self._disc_fp)
+        for i, name in enumerate(self._prop_names):
+            if found[i] and name not in self._found_names:
+                self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
+
     def _run_block(self, max_count: int = 1500) -> None:
+        """One dispatch per call: one BFS level (``levels_per_dispatch=1``)
+        or an on-device block of up to that many levels."""
+        if self._levels_per_dispatch > 1:
+            return self._run_block_fused()
+        return self._run_block_single()
+
+    def _run_block_fused(self) -> None:
+        """Up to ``levels_per_dispatch`` BFS levels in one device call (see
+        ``_build_fused``). Overflow exits commit every level before the
+        overflowing one, grow, and re-enter with the remaining budget."""
+        import jax.numpy as jnp
+
+        if self._target_reached or self._exhausted:
+            return
+        if all(name in self._found_names for name in self._prop_names) and self._P > 0:
+            return
+        if self._frontier_count == 0:
+            self._exhausted = True
+            return
+        self._max_depth = max(self._max_depth, self._depth)
+        if self._target_max_depth is not None and self._depth >= self._target_max_depth:
+            self._frontier_count = 0
+            self._exhausted = True
+            return
+
+        budget_left = self._levels_per_dispatch
+        if self._target_max_depth is not None:
+            budget_left = min(budget_left, self._target_max_depth - self._depth)
+        run_cap = self._run_cap_for(self._frontier_count)
+        while budget_left > 0:
+            # Keep the block's int32 generated-state accumulator safe.
+            kmax = max(1, (2**31 - 1) // max(run_cap * self._A, 1))
+            budget = min(budget_left, kmax)
+            remaining = 2**31 - 1
+            if self._target_state_count is not None:
+                remaining = max(
+                    1, min(remaining, self._target_state_count - self._state_count)
+                )
+            host_found = np.array(
+                [name in self._found_names for name in self._prop_names], dtype=bool
+            )
+            f_in, e_in = self._bucket_inputs(run_cap)
+            fn = self._fused_for(run_cap)
+            (
+                committed,
+                nf,
+                ne,
+                ncount,
+                table,
+                dfound,
+                dfp,
+                tot_states,
+                tot_unique,
+                ovf,
+                hv_w,
+                hv_f,
+                hv_c,
+            ) = fn(
+                f_in,
+                e_in,
+                self._frontier_count,
+                self._table,
+                self._disc_found,
+                self._disc_fp,
+                jnp.int32(budget),
+                jnp.int32(remaining),
+                jnp.asarray(host_found),
+            )
+            # Commit the non-overflowing prefix of the block.
+            committed = int(committed)
+            self._frontier, self._frontier_ebits, self._table = nf, ne, table
+            self._frontier_count = int(ncount)
+            self._disc_found, self._disc_fp = dfound, dfp
+            self._state_count += int(tot_states)
+            self._unique_count += int(tot_unique)
+            self._depth += committed
+            if committed:
+                self._max_depth = max(self._max_depth, self._depth - 1)
+            budget_left -= committed
+            if self._hv_idx:
+                self._confirm_hv_candidates(hv_w, hv_f, hv_c)
+            self._pin_found_names()
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                self._target_reached = True
+                return
+            t_ovf, f_ovf, c_ovf = (bool(x) for x in np.asarray(ovf))
+            if c_ovf:
+                self._raise_codec_overflow()
+            if t_ovf:
+                self._grow_table()
+                continue
+            if f_ovf:
+                run_cap = self._grow_frontier(run_cap)
+                continue
+            if self._frontier_count == 0 or committed == 0:
+                break
+            if self._P > 0 and all(
+                name in self._found_names for name in self._prop_names
+            ):
+                break
+
+    def _run_block_single(self) -> None:
         """One BFS level per call (level-synchronous super-step)."""
         import jax
         import jax.numpy as jnp
@@ -529,22 +826,7 @@ class XlaChecker(Checker):
         # is O(run_cap), not O(frontier_capacity).
         run_cap = self._run_cap_for(self._frontier_count)
         while True:  # retried only on capacity growth
-            stored = self._frontier.shape[0]
-            if stored < run_cap:
-                f_in = jnp.concatenate(
-                    [
-                        self._frontier,
-                        jnp.zeros((run_cap - stored, self._W), jnp.uint32),
-                    ]
-                )
-                e_in = jnp.concatenate(
-                    [self._frontier_ebits, jnp.zeros((run_cap - stored,), jnp.uint32)]
-                )
-            elif stored > run_cap:
-                f_in = jax.lax.slice_in_dim(self._frontier, 0, run_cap)
-                e_in = jax.lax.slice_in_dim(self._frontier_ebits, 0, run_cap)
-            else:
-                f_in, e_in = self._frontier, self._frontier_ebits
+            f_in, e_in = self._bucket_inputs(run_cap)
             fn = self._superstep_for(run_cap)
             out = fn(
                 f_in,
@@ -571,26 +853,14 @@ class XlaChecker(Checker):
                 hv_counts,
             ) = out
             if bool(c_ovf):
-                raise RuntimeError(
-                    f"{type(self._model).__name__}: packed-codec capacity "
-                    "overflow — a reachable successor does not fit the "
-                    "model's declared field widths/slot counts. Raise the "
-                    "model's capacity bounds (this is the loud failure the "
-                    "packed toolkit guarantees; see stateright_tpu.packing)."
-                )
+                self._raise_codec_overflow()
             if bool(t_ovf):
                 # Functional arrays: the pre-step table is untouched; grow
                 # and re-run the same level.
                 self._grow_table()
                 continue
             if bool(f_ovf):
-                if run_cap < self._frontier_capacity:
-                    run_cap = min(run_cap * 4, self._frontier_capacity)
-                    continue
-                # The compaction output exceeded even the top bucket: raise
-                # the ceiling and retry the level at the new top.
-                self._frontier_capacity *= 2
-                run_cap = self._frontier_capacity
+                run_cap = self._grow_frontier(run_cap)
                 continue
             break
 
@@ -602,12 +872,7 @@ class XlaChecker(Checker):
         self._depth += 1
         if self._hv_idx:
             self._confirm_hv_candidates(hv_words, hv_fps, hv_counts)
-        # Pin first-found witnesses by name.
-        found = np.asarray(self._disc_found)
-        fps = np.asarray(self._disc_fp)
-        for i, name in enumerate(self._prop_names):
-            if found[i] and name not in self._found_names:
-                self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
+        self._pin_found_names()
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count

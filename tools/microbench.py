@@ -1,0 +1,98 @@
+"""Microbenchmark the device-engine cost model on the current backend.
+
+Separates the four costs that determine checker throughput so tuning is
+evidence-driven rather than guesswork:
+
+1. dispatch RTT — a trivial jit call (the floor for any per-level host sync;
+   large over the axon tunnel),
+2. superstep compile time per bucket size,
+3. steady-state superstep wall time per bucket (states/sec at that width),
+4. hash-set insert cost vs batch size (the scatter-heavy op most likely to
+   be TPU-hostile).
+
+Usage: python tools/microbench.py [rm] [--pow P ...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, n=5):
+    fn(*args)  # compile / warm
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn(*args)
+    import jax
+
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    rm = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    print(f"backend={jax.default_backend()} device={jax.devices()[0]}", flush=True)
+
+    # 1. dispatch RTT
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.uint32)
+    rtt = timeit(lambda v: f(v), x, n=20)
+    print(f"dispatch RTT (trivial jit): {rtt*1e3:.2f} ms", flush=True)
+
+    # 2+3. superstep compile + steady time per bucket
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    model = PackedTwoPhaseSys(rm)
+    c = model.checker().spawn_xla(
+        frontier_capacity=1 << 17, table_capacity=1 << 22, levels_per_dispatch=1
+    )
+    from stateright_tpu.ops import fphash, hashset
+
+    for pow2 in (10, 12, 14, 16, 17):
+        cap = 1 << pow2
+        t0 = time.monotonic()
+        step = c._superstep_for(cap)
+        frontier = jnp.zeros((cap, model.state_words), jnp.uint32)
+        ebits = jnp.zeros((cap,), jnp.uint32)
+        out = step(
+            frontier, ebits, jnp.int32(cap), c._table, c._disc_found, c._disc_fp
+        )
+        jax.block_until_ready(out)
+        compile_s = time.monotonic() - t0
+        dt = timeit(
+            lambda: step(
+                frontier, ebits, jnp.int32(cap), c._table, c._disc_found, c._disc_fp
+            ),
+            n=5,
+        )
+        cands = cap * model.max_actions
+        print(
+            f"superstep bucket 2^{pow2}: compile {compile_s:6.1f}s  steady "
+            f"{dt*1e3:8.1f} ms  ({cands/dt/1e6:8.2f} M cand/s)",
+            flush=True,
+        )
+
+    # 4. insert cost vs batch
+    table = hashset.make(1 << 22, jnp)
+    ins = jax.jit(hashset.insert, static_argnames="max_probes")
+    for pow2 in (14, 17, 20, 22):
+        m = 1 << pow2
+        rng = np.random.default_rng(0)
+        hi = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+        lo = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+        act = jnp.ones((m,), jnp.bool_)
+        dt = timeit(lambda: ins(table, hi, lo, hi, lo, act), n=3)
+        print(
+            f"hashset.insert m=2^{pow2}: {dt*1e3:8.1f} ms  ({m/dt/1e6:8.2f} M ins/s)",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
