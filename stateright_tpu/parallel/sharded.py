@@ -73,6 +73,7 @@ class ShardedXlaChecker(Checker):
         route_capacity: Optional[int] = None,
         max_probes: int = 32,
         visit_cap: int = 4096,
+        levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
     ):
         import jax
@@ -102,6 +103,11 @@ class ShardedXlaChecker(Checker):
         self._target_max_depth = builder._target_max_depth
         self._visitor = builder._visitor
         self._visit_cap = visit_cap
+        # Same contract as the single-chip engine: the level loop runs on
+        # device, up to this many levels per dispatch (visitors force 1).
+        self._levels_per_dispatch = (
+            1 if self._visitor is not None else max(1, levels_per_dispatch)
+        )
         self._properties = model.properties()
         self._prop_names = [p.name for p in self._properties]
         self._ebit_of_prop: Dict[int, int] = {}
@@ -395,10 +401,12 @@ class ShardedXlaChecker(Checker):
             self._table = hashset.HashSet(*planes)
             return int(np.asarray(unique))
 
-    def _build_superstep(self, Fl: int, Cl: int, K: int):
+    def _make_local_step(self, Fl: int, Cl: int, K: int):
+        """The per-shard superstep body (one BFS level), without the
+        ``shard_map`` wrapper — shared by the one-level and fused
+        programs."""
         import jax
         import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
 
         model = self._model
         prop_specs = [(i, p.expectation) for i, p in enumerate(self._properties)]
@@ -583,11 +591,16 @@ class ShardedXlaChecker(Checker):
                 codec_ovf,
             )
 
+        return superstep
+
+    def _build_superstep(self, Fl: int, Cl: int, K: int):
+        from jax.sharding import PartitionSpec as P
+
         spec_rows = P("shards", None)
         spec_plane = P("shards")
         spec_rep = P()
         return self._shard_map(
-            superstep,
+            self._make_local_step(Fl, Cl, K),
             in_specs=(
                 spec_rows,
                 spec_plane,
@@ -612,11 +625,123 @@ class ShardedXlaChecker(Checker):
             ),
         )
 
+    def _build_fused(self, Fl: int, Cl: int, K: int):
+        """The level loop as one SPMD program: a ``lax.while_loop`` (with
+        the cross-shard collectives inside its body) around the local
+        superstep. Every shard computes the exit condition from replicated
+        values, so the loop stays in lockstep. Exit conditions mirror the
+        single-chip fused block (xla.py ``_build_fused``): level budget,
+        global frontier exhaustion, any overflow (the overflowing level is
+        NOT committed), every property found, or a state-count target."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        local_step = self._make_local_step(Fl, Cl, K)
+        P_count = self._P
+
+        def fused(frontier, f_ebits, count, table, disc_found, disc_fp,
+                  budget, remaining, host_found):
+            def resolved(df):
+                if P_count == 0:
+                    return jnp.bool_(False)
+                return jnp.all(df | host_found)
+
+            def cond(carry):
+                (lvl, committed, fr, eb, cnt, tab, df, dfp, ts, tu, ovf,
+                 gcount) = carry
+                return (
+                    (lvl < budget)
+                    & (gcount > 0)
+                    & ~jnp.any(ovf)
+                    & ~resolved(df)
+                    & (ts < remaining)
+                )
+
+            def body(carry):
+                (lvl, committed, fr, eb, cnt, tab, df, dfp, ts, tu, ovf,
+                 gcount) = carry
+                (nf, ne, ncnt, ntab, ndf, ndfp, ds, du, t_ovf, f_ovf,
+                 r_ovf, c_ovf) = local_step(fr, eb, cnt, tab, df, dfp)
+                commit = ~(t_ovf | f_ovf | r_ovf | c_ovf)
+                sel = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(commit, a, b), new, old
+                )
+                return (
+                    lvl + 1,
+                    committed + commit.astype(jnp.int32),
+                    sel(nf, fr),
+                    sel(ne, eb),
+                    sel(ncnt, cnt),
+                    sel(ntab, tab),
+                    sel(ndf, df),
+                    sel(ndfp, dfp),
+                    ts + jnp.where(commit, ds, 0),
+                    tu + jnp.where(commit, du, 0),
+                    jnp.stack([t_ovf, f_ovf, r_ovf, c_ovf]),
+                    jnp.where(commit, jax.lax.psum(ncnt[0], "shards"), gcount),
+                )
+
+            carry0 = (
+                jnp.int32(0),
+                jnp.int32(0),
+                frontier,
+                f_ebits,
+                count,
+                table,
+                disc_found,
+                disc_fp,
+                jnp.int32(0),
+                jnp.int32(0),
+                jnp.zeros((4,), jnp.bool_),
+                jax.lax.psum(count[0], "shards"),
+            )
+            out = jax.lax.while_loop(cond, body, carry0)
+            return out[1:11]  # drop the level counter and the global count
+
+        spec_rows = P("shards", None)
+        spec_plane = P("shards")
+        spec_rep = P()
+        return self._shard_map(
+            fused,
+            in_specs=(
+                spec_rows,
+                spec_plane,
+                spec_plane,
+                (spec_plane,) * 4,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+            ),
+            out_specs=(
+                spec_rep,
+                spec_rows,
+                spec_plane,
+                spec_plane,
+                (spec_plane,) * 4,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+                spec_rep,
+            ),
+        )
+
     def _superstep(self):
         key = (self._Fl, self._Cl, self._K)
         fn = self._step_cache.get(key)
         if fn is None:
             fn = self._build_superstep(*key)
+            self._step_cache[key] = fn
+        return fn
+
+    def _fused(self):
+        key = ("fused", self._Fl, self._Cl, self._K)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build_fused(self._Fl, self._Cl, self._K)
             self._step_cache[key] = fn
         return fn
 
@@ -679,16 +804,22 @@ class ShardedXlaChecker(Checker):
     # --- engine ------------------------------------------------------------
 
     def _run_block(self, max_count: int = 1500) -> None:
+        if self._levels_per_dispatch > 1:
+            return self._run_block_fused()
+        return self._run_block_single()
+
+    def _entry_checks(self) -> bool:
+        """Shared dispatch preamble; returns False when nothing to run."""
         import numpy as np
 
         if self._target_reached or self._exhausted:
-            return
+            return False
         if self._P > 0 and all(n in self._found_names for n in self._prop_names):
-            return
+            return False
         total = int(np.sum(np.asarray(self._counts)))
         if total == 0:
             self._exhausted = True
-            return
+            return False
         self._max_depth = max(self._max_depth, self._depth)
         if self._target_max_depth is not None and self._depth >= self._target_max_depth:
             # Mirror the single-chip engine: a depth-halted checker reads as
@@ -697,6 +828,111 @@ class ShardedXlaChecker(Checker):
 
             self._counts = jnp.zeros_like(self._counts)
             self._exhausted = True
+            return False
+        return True
+
+    def _pin_found_names(self) -> None:
+        found = np.asarray(self._disc_found)
+        fps = np.asarray(self._disc_fp)
+        for i, name in enumerate(self._prop_names):
+            if found[i] and name not in self._found_names:
+                self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
+
+    def _run_block_fused(self) -> None:
+        """Up to ``levels_per_dispatch`` BFS levels in one SPMD dispatch
+        (see ``_build_fused``); overflow exits commit the non-overflowing
+        prefix, grow the overflowing buffer, and re-enter."""
+        import jax.numpy as jnp
+
+        if not self._entry_checks():
+            return
+        budget_left = self._levels_per_dispatch
+        if self._target_max_depth is not None:
+            budget_left = min(budget_left, self._target_max_depth - self._depth)
+        while budget_left > 0:
+            # Keep the block's int32 generated-state accumulator safe:
+            # global candidates per level = D * Fl * A.
+            kmax = max(1, (2**31 - 1) // max(self._D * self._Fl * self._A, 1))
+            budget = min(budget_left, kmax)
+            remaining = 2**31 - 1
+            if self._target_state_count is not None:
+                remaining = max(
+                    1, min(remaining, self._target_state_count - self._state_count)
+                )
+            host_found = np.array(
+                [n in self._found_names for n in self._prop_names], dtype=bool
+            )
+            fn = self._fused()
+            (
+                committed,
+                nf,
+                ne,
+                ncounts,
+                table,
+                dfound,
+                dfp,
+                tot_states,
+                tot_unique,
+                ovf,
+            ) = fn(
+                self._frontier,
+                self._frontier_ebits,
+                self._counts,
+                tuple(self._table),
+                self._disc_found,
+                self._disc_fp,
+                jnp.int32(budget),
+                jnp.int32(remaining),
+                jnp.asarray(host_found),
+            )
+            committed = int(np.asarray(committed))
+            self._frontier, self._frontier_ebits = nf, ne
+            self._counts = ncounts
+            self._table = hashset.HashSet(*table)
+            self._disc_found, self._disc_fp = dfound, dfp
+            self._state_count += int(np.asarray(tot_states))
+            self._unique_count += int(np.asarray(tot_unique))
+            self._depth += committed
+            if committed:
+                self._max_depth = max(self._max_depth, self._depth - 1)
+            budget_left -= committed
+            self._pin_found_names()
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                self._target_reached = True
+                return
+            t_ovf, f_ovf, r_ovf, c_ovf = (bool(x) for x in np.asarray(ovf))
+            if c_ovf:
+                raise RuntimeError(
+                    f"{type(self._model).__name__}: packed-codec capacity "
+                    "overflow — a reachable successor does not fit the "
+                    "model's declared field widths/slot counts (see "
+                    "stateright_tpu.packing)."
+                )
+            if t_ovf:
+                self._grow_table()
+                continue
+            if f_ovf:
+                self._grow_frontier()
+                continue
+            if r_ovf:
+                self._K = min(self._Fl * self._A, self._K * 2)
+                continue
+            if committed == 0:
+                break
+            if int(np.sum(np.asarray(self._counts))) == 0:
+                break
+            if self._P > 0 and all(
+                n in self._found_names for n in self._prop_names
+            ):
+                break
+
+    def _run_block_single(self) -> None:
+        import numpy as np
+
+        if not self._entry_checks():
             return
         if self._visitor is not None:
             self._visit_frontier()
@@ -738,11 +974,7 @@ class ShardedXlaChecker(Checker):
         self._state_count += int(np.asarray(d_states))
         self._unique_count += int(np.asarray(d_unique))
         self._depth += 1
-        found = np.asarray(self._disc_found)
-        fps = np.asarray(self._disc_fp)
-        for i, name in enumerate(self._prop_names):
-            if found[i] and name not in self._found_names:
-                self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
+        self._pin_found_names()
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count
