@@ -28,7 +28,17 @@ class CheckerBuilder:
     # --- terminal strategies ---------------------------------------------
 
     def spawn_bfs(self) -> Checker:
-        """Breadth-first search; shortest witness paths (checker.rs:155)."""
+        """Breadth-first search; shortest witness paths (checker.rs:155).
+
+        With ``threads(n)`` for n > 1 (and no visitor), a level-synchronous
+        multiprocess engine expands the frontier across n forked workers
+        with fingerprint-sharded visited sets
+        (``stateright_tpu.checker.parallel_host``) — the host analogue of
+        the reference's worker pool (bfs.rs:89-211)."""
+        if (self._thread_count or 1) > 1 and self._visitor is None:
+            from .parallel_host import ParallelBfsChecker
+
+            return ParallelBfsChecker(self)
         from .search import BfsChecker
 
         return BfsChecker(self)
@@ -108,9 +118,11 @@ class CheckerBuilder:
         return self
 
     def threads(self, thread_count: int) -> "CheckerBuilder":
-        """Accepted for API parity (checker.rs:234). The host engines are
-        sequential; parallelism comes from the XLA engine, which uses every
-        core of every chip in the mesh regardless of this setting."""
+        """Worker count for the host engines (checker.rs:234). With n > 1,
+        ``spawn_bfs`` runs the multiprocess level-synchronous engine
+        (``stateright_tpu.checker.parallel_host``); DFS stays sequential
+        (its massive parallel form in this framework is the XLA engine,
+        which uses every core of every chip regardless of this setting)."""
         self._thread_count = thread_count
         return self
 
