@@ -115,6 +115,7 @@ def _run_matrix(platform: str) -> list:
     time-to-full-coverage): the flagship actor examples on the device
     engine. Warm + measured pass each; small spaces, so these anchor
     time-to-coverage rather than steady-state throughput."""
+    from stateright_tpu.models.increment_lock import PackedIncrementLock
     from stateright_tpu.models.linearizable_register import PackedAbd
     from stateright_tpu.models.paxos import PackedPaxos
     from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
@@ -135,6 +136,11 @@ def _run_matrix(platform: str) -> list:
             "single-copy-register 2c/1s packed",
             lambda: PackedSingleCopyRegister(2, 1),
             dict(frontier_capacity=1 << 10, table_capacity=1 << 12),
+        ),
+        (
+            "increment_lock 3t packed",
+            lambda: PackedIncrementLock(3),
+            dict(frontier_capacity=1 << 10, table_capacity=1 << 13),
         ),
     ]:
         try:

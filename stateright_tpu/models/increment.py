@@ -73,8 +73,104 @@ class Increment(Model):
         ]
 
 
+class PackedIncrement(Increment):
+    """The racy counter on the device engine (``spawn_xla``), declared via
+    :mod:`stateright_tpu.packing`: the shared counter and per-thread
+    ``(t, pc)`` slices are plain layout fields. One action slot per thread
+    (its program counter enables at most one instruction, increment.rs:158-169).
+
+    Includes ``packed_representative`` — threads sort by ``(t, pc)``
+    (increment.rs:142-151) — so ``check-sym`` runs on device too.
+    """
+
+    def __init__(self, thread_count: int = 3):
+        from ..packing import LayoutBuilder, bits_for
+
+        super().__init__(thread_count)
+        n = thread_count
+        tb = bits_for(n)
+        self._layout = (
+            LayoutBuilder()
+            .uint("i", bits_for(n))
+            .array("t", n, tb)
+            .array("pc", n, 2)  # 1..3
+            .finish()
+        )
+        self.state_words = self._layout.words
+        self.max_actions = n
+
+    # --- host codec --------------------------------------------------------
+
+    def pack(self, state: IncrementState):
+        return self._layout.pack(
+            i=state.i,
+            t=[t for t, _pc in state.s],
+            pc=[pc for _t, pc in state.s],
+        )
+
+    def unpack(self, words) -> IncrementState:
+        f = self._layout.unpack(words)
+        return IncrementState(
+            f["i"], tuple(zip((int(x) for x in f["t"]), (int(x) for x in f["pc"])))
+        )
+
+    def packed_init(self):
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self.init_states()])
+
+    # --- device kernels -----------------------------------------------------
+
+    def packed_step(self, words):
+        """Slot k = thread k's enabled instruction: Read at pc=1 (t := i,
+        pc := 2), Write at pc=2 (i := t+1, pc := 3)."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.thread_count
+        i_val = L.get(words, "i")
+        nxt, valid = [], []
+        for k in range(n):
+            pc = L.get(words, "pc", k)
+            t = L.get(words, "t", k)
+            read_w = L.set(L.set(words, "t", i_val, k), "pc", 2, k)
+            write_w = L.set(L.set(words, "i", t + jnp.uint32(1)), "pc", 3, k)
+            is_read = pc == 1
+            w = jnp.where(is_read, read_w, write_w)
+            nxt.append(w)
+            valid.append(is_read | (pc == 2))
+        return jnp.stack(nxt), jnp.stack(valid)
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.thread_count
+        fin = jnp.uint32(0)
+        for k in range(n):
+            fin = fin + (L.get(words, "pc", k) == 3).astype(jnp.uint32)
+        return jnp.stack([fin == L.get(words, "i")])
+
+    def packed_representative(self, words):
+        """Sort the interchangeable thread slice by ``(t, pc)`` — the
+        device form of :meth:`IncrementState.representative`."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.thread_count
+        t = jnp.stack([L.get(words, "t", k) for k in range(n)])
+        pc = jnp.stack([L.get(words, "pc", k) for k in range(n)])
+        keys = t * jnp.uint32(4) + pc  # pc < 4; lexicographic (t, pc)
+        order = jnp.argsort(keys, stable=True)
+        t, pc = t[order], pc[order]
+        w = words
+        for k in range(n):
+            w = L.set(L.set(w, "t", t[k], k), "pc", pc[k], k)
+        return w
+
+
 def main(argv=None) -> None:
-    """CLI mirroring increment.rs:199-254."""
+    """CLI mirroring increment.rs:199-254, plus ``check-xla``."""
     import sys
 
     from ..report import WriteReporter
@@ -85,6 +181,12 @@ def main(argv=None) -> None:
         thread_count = int(args.pop(0)) if args else 3
         print(f"Model checking increment with {thread_count} threads.")
         Increment(thread_count).checker().spawn_dfs().report(WriteReporter())
+    elif cmd == "check-xla":
+        thread_count = int(args.pop(0)) if args else 3
+        print(f"Model checking increment with {thread_count} threads on XLA.")
+        PackedIncrement(thread_count).checker().spawn_xla(
+            frontier_capacity=1 << 12, table_capacity=1 << 16
+        ).report(WriteReporter())
     elif cmd == "check-sym":
         thread_count = int(args.pop(0)) if args else 3
         print(
@@ -106,6 +208,7 @@ def main(argv=None) -> None:
         print("USAGE:")
         print("  increment check [THREAD_COUNT]")
         print("  increment check-sym [THREAD_COUNT]")
+        print("  increment check-xla [THREAD_COUNT]")
         print("  increment explore [THREAD_COUNT] [ADDRESS]")
 
 

@@ -84,8 +84,112 @@ class IncrementLock(Model):
         ]
 
 
+class PackedIncrementLock(IncrementLock):
+    """The lock-guarded counter on the device engine (``spawn_xla``).
+
+    Same layout style as :class:`~stateright_tpu.models.increment.PackedIncrement`
+    plus a global lock flag; one action slot per thread (each program
+    counter enables at most one of Lock/Read/Write/Release,
+    increment_lock.rs:61-73)."""
+
+    def __init__(self, thread_count: int = 3):
+        from ..packing import LayoutBuilder, bits_for
+
+        super().__init__(thread_count)
+        n = thread_count
+        self._layout = (
+            LayoutBuilder()
+            .uint("i", bits_for(n))
+            .flag("lock")
+            .array("t", n, bits_for(n))
+            .array("pc", n, 3)  # 0..4
+            .finish()
+        )
+        self.state_words = self._layout.words
+        self.max_actions = n
+
+    def pack(self, state: IncrementLockState):
+        return self._layout.pack(
+            i=state.i,
+            lock=int(state.lock),
+            t=[t for t, _pc in state.s],
+            pc=[pc for _t, pc in state.s],
+        )
+
+    def unpack(self, words) -> IncrementLockState:
+        f = self._layout.unpack(words)
+        return IncrementLockState(
+            f["i"],
+            bool(f["lock"]),
+            tuple(zip((int(x) for x in f["t"]), (int(x) for x in f["pc"]))),
+        )
+
+    def packed_init(self):
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self.init_states()])
+
+    def packed_step(self, words):
+        """Slot k: thread k's one enabled instruction, by program counter —
+        Lock (pc=0, lock free), Read (1), Write (2), Release (3)."""
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.thread_count
+        i_val = L.get(words, "i")
+        lock = L.get(words, "lock") != 0
+        nxt, valid = [], []
+        for k in range(n):
+            pc = L.get(words, "pc", k)
+            t = L.get(words, "t", k)
+            lock_w = L.set(L.set(words, "lock", 1), "pc", 1, k)
+            read_w = L.set(L.set(words, "t", i_val, k), "pc", 2, k)
+            write_w = L.set(L.set(words, "i", t + jnp.uint32(1)), "pc", 3, k)
+            rel_w = L.set(L.set(words, "lock", 0), "pc", 4, k)
+            w = jnp.where(
+                pc == 0, lock_w,
+                jnp.where(pc == 1, read_w, jnp.where(pc == 2, write_w, rel_w)),
+            )
+            ok = jnp.where(
+                pc == 0, ~lock,
+                jnp.where((pc == 1) | (pc == 2), jnp.bool_(True),
+                          (pc == 3) & lock),
+            )
+            nxt.append(w)
+            valid.append(ok & (pc < 4))
+        return jnp.stack(nxt), jnp.stack(valid)
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.thread_count
+        fin = jnp.uint32(0)
+        crit = jnp.uint32(0)
+        for k in range(n):
+            pc = L.get(words, "pc", k)
+            fin = fin + (pc >= 3).astype(jnp.uint32)
+            crit = crit + ((pc >= 1) & (pc < 4)).astype(jnp.uint32)
+        return jnp.stack([fin == L.get(words, "i"), crit <= 1])
+
+    def packed_representative(self, words):
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.thread_count
+        t = jnp.stack([L.get(words, "t", k) for k in range(n)])
+        pc = jnp.stack([L.get(words, "pc", k) for k in range(n)])
+        keys = t * jnp.uint32(8) + pc  # pc < 8; lexicographic (t, pc)
+        order = jnp.argsort(keys, stable=True)
+        t, pc = t[order], pc[order]
+        w = words
+        for k in range(n):
+            w = L.set(L.set(w, "t", t[k], k), "pc", pc[k], k)
+        return w
+
+
 def main(argv=None) -> None:
-    """CLI mirroring increment_lock.rs:109-161."""
+    """CLI mirroring increment_lock.rs:109-161, plus ``check-xla``."""
     import sys
 
     from ..report import WriteReporter
@@ -96,6 +200,12 @@ def main(argv=None) -> None:
         thread_count = int(args.pop(0)) if args else 3
         print(f"Model checking increment_lock with {thread_count} threads.")
         IncrementLock(thread_count).checker().spawn_dfs().report(WriteReporter())
+    elif cmd == "check-xla":
+        thread_count = int(args.pop(0)) if args else 3
+        print(f"Model checking increment_lock with {thread_count} threads on XLA.")
+        PackedIncrementLock(thread_count).checker().spawn_xla(
+            frontier_capacity=1 << 12, table_capacity=1 << 16
+        ).report(WriteReporter())
     elif cmd == "check-sym":
         thread_count = int(args.pop(0)) if args else 3
         print(
@@ -117,6 +227,7 @@ def main(argv=None) -> None:
         print("USAGE:")
         print("  increment_lock check [THREAD_COUNT]")
         print("  increment_lock check-sym [THREAD_COUNT]")
+        print("  increment_lock check-xla [THREAD_COUNT]")
         print("  increment_lock explore [THREAD_COUNT] [ADDRESS]")
 
 

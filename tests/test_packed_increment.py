@@ -1,0 +1,86 @@
+"""Packed increment / increment_lock on the device engine vs the oracle.
+
+Oracles: 13 unique states for the 2-thread racy increment, 8 under symmetry
+reduction (examples/increment.rs:31-105); the lock variant satisfies both
+``fin`` and ``mutex``. Counts come from full-space enumeration (a
+``sometimes`` unreachable property forces exhaustion, as in
+test_increment_examples.py).
+"""
+
+from stateright_tpu.core import Property
+from stateright_tpu.models.increment import Increment, PackedIncrement
+from stateright_tpu.models.increment_lock import IncrementLock, PackedIncrementLock
+
+KW = dict(frontier_capacity=1 << 10, table_capacity=1 << 13)
+
+
+class _FullSpace:
+    """Mixin: replace the always-props with an unreachable sometimes so the
+    search exhausts the space (engine early-exit otherwise stops at the
+    race counterexample)."""
+
+    def properties(self):
+        return [Property.sometimes("unreachable", lambda _m, _s: False)]
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+
+        return jnp.stack([jnp.bool_(False)])
+
+
+class _PackedIncrementFull(_FullSpace, PackedIncrement):
+    pass
+
+
+class _IncrementFull(_FullSpace, Increment):
+    pass
+
+
+class _PackedIncrementLockFull(_FullSpace, PackedIncrementLock):
+    pass
+
+
+class _IncrementLockFull(_FullSpace, IncrementLock):
+    pass
+
+
+def test_packed_increment_full_space_parity():
+    assert _PackedIncrementFull(2).checker().spawn_xla(**KW).join().unique_state_count() == 13
+    seq = _IncrementFull(3).checker().spawn_bfs().join()
+    dev = _PackedIncrementFull(3).checker().spawn_xla(**KW).join()
+    assert dev.unique_state_count() == seq.unique_state_count()
+    assert dev.state_count() == seq.state_count()
+
+
+def test_packed_increment_symmetry():
+    dev = _PackedIncrementFull(2).checker().symmetry().spawn_xla(**KW).join()
+    assert dev.unique_state_count() == 8
+
+
+def test_packed_increment_race_discovery():
+    dev = PackedIncrement(2).checker().spawn_xla(**KW).join()
+    seq = Increment(2).checker().spawn_bfs().join()
+    assert "fin" in dev.discoveries()
+    # BFS witnesses are depth-minimal in both engines.
+    assert len(dev.discoveries()["fin"]) == len(seq.discoveries()["fin"])
+    final = dev.discoveries()["fin"].last_state()
+    assert sum(1 for _t, pc in final.s if pc == 3) != final.i
+
+
+def test_packed_increment_lock_full_space_parity():
+    seq = _IncrementLockFull(2).checker().spawn_bfs().join()
+    dev = _PackedIncrementLockFull(2).checker().spawn_xla(**KW).join()
+    assert dev.unique_state_count() == seq.unique_state_count()
+    assert dev.state_count() == seq.state_count()
+
+
+def test_packed_increment_lock_holds():
+    dev = PackedIncrementLock(2).checker().spawn_xla(**KW).join()
+    dev.assert_properties()  # fin and mutex both hold
+    assert dev.unique_state_count() > 0
+
+
+def test_packed_increment_lock_symmetry_parity():
+    seq = _IncrementLockFull(2).checker().symmetry().spawn_bfs().join()
+    dev = _PackedIncrementLockFull(2).checker().symmetry().spawn_xla(**KW).join()
+    assert dev.unique_state_count() == seq.unique_state_count()
