@@ -26,6 +26,8 @@ from ..actor import (
     model_peers,
     model_timeout,
 )
+from ..actor.network import Envelope
+from ..actor.timers import Timers
 from ..core import Expectation
 from ..utils.variant import variant
 
@@ -88,6 +90,184 @@ def timers_model(
     )
 
 
+class PackedTimers:
+    """The Pingers system on the device engine (``spawn_xla``) — timers on
+    device, completing device-engine coverage of every reference example.
+
+    Pending timers need no storage: every actor's set is constantly
+    ``{Even, Odd, NoOp}`` (all three are re-armed on every firing and never
+    cancelled, timers.rs:50-74). The ``NoOp`` timeout gets no action slot —
+    its pure re-arm is suppressed by no-op detection in the object model
+    (``is_no_op_with_timer``, actor.rs:254-264) and is statically never
+    enabled here. ``Even``/``Odd`` timeout slots are statically valid
+    whenever the actor has a peer of that parity, and bump ``sent`` by the
+    (static) peer count while incrementing each Ping's multiset count.
+
+    The space is unbounded (counters grow), so device runs use
+    ``target_state_count``/``target_max_depth`` exactly like the object
+    CLI; counters and envelope counts that outgrow their declared widths
+    surface as the loud codec-overflow failure.
+    """
+
+    def __init__(self, server_count: int = 3, *, count_bits: int = 8,
+                 net_bits: int = 5):
+        from ..packing import LayoutBuilder
+
+        n = server_count
+        self.n = n
+        self._inner = timers_model(n)
+        # Closed envelope universe: Ping(i->j) then Pong(i->j), i != j.
+        pairs = [(i, j) for i in range(n) for j in range(n) if i != j]
+        self._pairs = pairs
+        U = 2 * len(pairs)
+        self._U = U
+        self._ping_code = {p: c for c, p in enumerate(pairs)}
+        self._pong_code = {p: len(pairs) + c for c, p in enumerate(pairs)}
+        self._count_bits, self._net_bits = count_bits, net_bits
+        self._layout = (
+            LayoutBuilder()
+            .array("sent", n, count_bits)
+            .array("recv", n, count_bits)
+            .array("net", U, net_bits)
+            .finish()
+        )
+        self.state_words = self._layout.words
+        # Slots: [Even timeout x n, Odd timeout x n, one delivery per code].
+        self.max_actions = 2 * n + U
+        # Static per-actor parity targets.
+        self._targets = {
+            (i, parity): [j for j in range(n) if j != i and j % 2 == parity]
+            for i in range(n)
+            for parity in (0, 1)
+        }
+
+    # --- object-level Model API --------------------------------------------
+
+    def checker(self):
+        from ..checker.builder import CheckerBuilder
+
+        return CheckerBuilder(self)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # --- codec --------------------------------------------------------------
+
+    def pack(self, state):
+        from ..packing import OverflowError32
+
+        sent = [s.sent for s in state.actor_states]
+        recv = [s.received for s in state.actor_states]
+        net = [0] * self._U
+        for env, count in state.network.counts.items():
+            pair = (int(env.src), int(env.dst))
+            code = (
+                self._ping_code.get(pair)
+                if isinstance(env.msg, Ping)
+                else self._pong_code.get(pair)
+            )
+            if code is None:
+                raise OverflowError32(f"envelope outside universe: {env!r}")
+            net[code] = count
+        for v in sent + recv:
+            if v >= 1 << self._count_bits:
+                raise OverflowError32(f"counter {v} exceeds {self._count_bits} bits")
+        for c in net:
+            if c >= 1 << self._net_bits:
+                raise OverflowError32(f"envelope count {c} exceeds {self._net_bits} bits")
+        return self._layout.pack(sent=sent, recv=recv, net=net)
+
+    def unpack(self, words):
+        from ..actor.model_state import ActorModelState
+        from ..actor.network import Network
+
+        from ..actor.network import UnorderedNonDuplicatingNetwork
+
+        f = self._layout.unpack(words)
+        counts = {}
+        for (i, j), c in self._ping_code.items():
+            if f["net"][c]:
+                counts[Envelope(Id(i), Id(j), Ping())] = int(f["net"][c])
+        for (i, j), c in self._pong_code.items():
+            if f["net"][c]:
+                counts[Envelope(Id(i), Id(j), Pong())] = int(f["net"][c])
+        timers = Timers(frozenset((Even(), Odd(), NoOp())))
+        return ActorModelState(
+            actor_states=tuple(
+                PingerState(int(f["sent"][k]), int(f["recv"][k]))
+                for k in range(self.n)
+            ),
+            network=UnorderedNonDuplicatingNetwork(counts),
+            timers_set=tuple(timers for _ in range(self.n)),
+            history=(),
+        )
+
+    def packed_init(self):
+        import numpy as np
+
+        return np.stack([self.pack(s) for s in self._inner.init_states()])
+
+    # --- device kernels ------------------------------------------------------
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+
+        L = self._layout
+        n = self.n
+        one = jnp.uint32(1)
+        cmax = jnp.uint32((1 << self._count_bits) - 1)
+        nmax = jnp.uint32((1 << self._net_bits) - 1)
+        nxt, valid, ovf = [], [], []
+
+        for i in range(n):
+            for parity in (0, 1):
+                targets = self._targets[(i, parity)]
+                if not targets:
+                    # No matching peer: the timeout is a pure re-arm, a
+                    # suppressed no-op — statically invalid.
+                    nxt.append(words)
+                    valid.append(jnp.bool_(False))
+                    ovf.append(jnp.bool_(False))
+                    continue
+                sent = L.get(words, "sent", i)
+                w = L.set(words, "sent", sent + jnp.uint32(len(targets)), i)
+                o = sent + jnp.uint32(len(targets)) > cmax
+                for j in targets:
+                    c = L.get(w, "net", self._ping_code[(i, j)])
+                    o = o | (c == nmax)
+                    w = L.set(w, "net", c + one, self._ping_code[(i, j)])
+                nxt.append(w)
+                valid.append(jnp.bool_(True))
+                ovf.append(o)
+
+        for (i, j), code in self._ping_code.items():
+            # Deliver Ping(i->j): j replies Pong(j->i).
+            c = L.get(words, "net", code)
+            pong = self._pong_code[(j, i)]
+            cp = L.get(words, "net", pong)
+            w = L.set(words, "net", c - one, code)
+            w = L.set(w, "net", cp + one, pong)
+            nxt.append(w)
+            valid.append(c > 0)
+            ovf.append((c > 0) & (cp == nmax))
+        for (i, j), code in self._pong_code.items():
+            # Deliver Pong(i->j): j counts a received pong.
+            c = L.get(words, "net", code)
+            r = L.get(words, "recv", j)
+            w = L.set(words, "net", c - one, code)
+            w = L.set(w, "recv", r + one, j)
+            nxt.append(w)
+            valid.append(c > 0)
+            ovf.append((c > 0) & (r == cmax))
+
+        return jnp.stack(nxt), jnp.stack(valid), jnp.stack(ovf)
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+
+        return jnp.stack([jnp.bool_(True)])  # the object model's "true"
+
+
 def main(argv=None) -> None:
     """CLI mirroring timers.rs:115-164 (``check`` bounded, see module doc)."""
     import sys
@@ -106,6 +286,15 @@ def main(argv=None) -> None:
             .spawn_dfs()
             .report(WriteReporter())
         )
+    elif cmd == "check-xla":
+        print("Model checking Pingers on XLA (bounded to 100k states).")
+        (
+            PackedTimers(3)
+            .checker()
+            .target_state_count(100_000)
+            .spawn_xla(frontier_capacity=1 << 15, table_capacity=1 << 18)
+            .report(WriteReporter())
+        )
     elif cmd == "explore":
         address = args.pop(0) if args else "localhost:3000"
         network = Network.from_name(args.pop(0)) if args else None
@@ -114,6 +303,7 @@ def main(argv=None) -> None:
     else:
         print("USAGE:")
         print("  timers check [NETWORK]")
+        print("  timers check-xla")
         print("  timers explore [ADDRESS] [NETWORK]")
         print(f"NETWORK: {' | '.join(Network.names())}")
 
