@@ -723,6 +723,14 @@ def main(argv=None) -> None:
             .spawn_dfs()
             .report(WriteReporter())
         )
+    elif cmd == "check-xla":
+        print("Model checking a linearizable register with 2 clients on XLA.")
+        (
+            PackedAbd(2, 2)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 12)
+            .report(WriteReporter())
+        )
     elif cmd == "explore":
         client_count = int(args.pop(0)) if args else 2
         address = args.pop(0) if args else "localhost:3000"
@@ -757,6 +765,7 @@ def main(argv=None) -> None:
     else:
         print("USAGE:")
         print("  linearizable-register check [CLIENT_COUNT] [NETWORK]")
+        print("  linearizable-register check-xla")
         print("  linearizable-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  linearizable-register spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")

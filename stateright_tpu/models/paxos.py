@@ -773,6 +773,18 @@ def main(argv=None) -> None:
             .spawn_dfs()
             .report(WriteReporter())
         )
+    elif cmd == "check-xla":
+        client_count = int(args.pop(0)) if args else 2
+        print(
+            f"Model checking Single Decree Paxos with {client_count} clients "
+            "on XLA."
+        )
+        (
+            PackedPaxos(client_count, 3)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 16)
+            .report(WriteReporter())
+        )
     elif cmd == "explore":
         client_count = int(args.pop(0)) if args else 2
         address = args.pop(0) if args else "localhost:3000"
@@ -807,6 +819,7 @@ def main(argv=None) -> None:
     else:
         print("USAGE:")
         print("  paxos check [CLIENT_COUNT] [NETWORK]")
+        print("  paxos check-xla [CLIENT_COUNT]")
         print("  paxos explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  paxos spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")

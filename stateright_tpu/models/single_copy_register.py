@@ -574,6 +574,20 @@ def main(argv=None) -> None:
             .spawn_dfs()
             .report(WriteReporter())
         )
+    elif cmd == "check-xla":
+        network = Network.from_name(args.pop(0)) if args else None
+        ordered = network is not None and "Ordered" in type(network).__name__
+        print("Model checking a single-copy register with 2 clients on XLA.")
+        model = (
+            PackedSingleCopyRegisterOrdered(2, 1)
+            if ordered
+            else PackedSingleCopyRegister(2, 1)
+        )
+        (
+            model.checker()
+            .spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 12)
+            .report(WriteReporter())
+        )
     elif cmd == "explore":
         client_count = int(args.pop(0)) if args else 2
         address = args.pop(0) if args else "localhost:3000"
@@ -601,6 +615,7 @@ def main(argv=None) -> None:
     else:
         print("USAGE:")
         print("  single-copy-register check [CLIENT_COUNT] [NETWORK]")
+        print("  single-copy-register check-xla [NETWORK]")
         print("  single-copy-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  single-copy-register spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")
