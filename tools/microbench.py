@@ -93,6 +93,48 @@ def main() -> None:
             flush=True,
         )
 
+    # 5. cost model for the sort-based dedup alternative: a two-key sort of
+    #    the candidate batch (in-batch dedup + visited-merge building block)
+    #    and a pure scatter vs gather-compaction comparison at batch size.
+    def sort2(hi, lo):
+        return jax.lax.sort((hi, lo), num_keys=2)
+
+    sort2j = jax.jit(sort2)
+    for pow2 in (17, 20, 22, 24):
+        m = 1 << pow2
+        rng = np.random.default_rng(1)
+        hi = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+        lo = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+        dt = timeit(lambda: sort2j(hi, lo), n=3)
+        print(
+            f"two-key sort m=2^{pow2}: {dt*1e3:8.1f} ms  ({m/dt/1e6:8.2f} M keys/s)",
+            flush=True,
+        )
+
+    W = 4
+    for pow2 in (17, 20):
+        m = 1 << pow2
+        rng = np.random.default_rng(2)
+        rows = jnp.asarray(rng.integers(0, 2**32, (m, W), dtype=np.uint32))
+        keep = jnp.asarray(rng.integers(0, 2, m, dtype=np.uint32).astype(bool))
+
+        def compact_scatter(rows, keep):
+            pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            idx = jnp.where(keep, pos, m)
+            return jnp.zeros((m, W), jnp.uint32).at[idx].set(rows, mode="drop")
+
+        def compact_gather(rows, keep):
+            order = jnp.argsort(~keep, stable=True)
+            return rows[order]
+
+        ds = timeit(jax.jit(compact_scatter), rows, keep, n=3)
+        dg = timeit(jax.jit(compact_gather), rows, keep, n=3)
+        print(
+            f"compaction m=2^{pow2} W={W}: scatter {ds*1e3:8.1f} ms vs "
+            f"sort+gather {dg*1e3:8.1f} ms",
+            flush=True,
+        )
+
 
 if __name__ == "__main__":
     main()
