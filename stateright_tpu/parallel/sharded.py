@@ -781,21 +781,28 @@ class ShardedXlaChecker(Checker):
         self._Cl = new_Cl
 
     def _grow_frontier(self) -> None:
-        import jax
+        """Double every shard's frontier rows, shard-locally on device (a
+        host round-trip here would stall every growth event at scale)."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
 
-        D, Fl, W = self._D, self._Fl, self._W
+        Fl, W = self._Fl, self._W
         new_Fl = Fl * 2
-        rows = np.asarray(self._frontier).reshape(D, Fl, W)
-        ebits = np.asarray(self._frontier_ebits).reshape(D, Fl)
-        grown = np.zeros((D, new_Fl, W), dtype=np.uint32)
-        grown[:, :Fl] = rows
-        gebits = np.zeros((D, new_Fl), dtype=np.uint32)
-        gebits[:, :Fl] = ebits
-        self._frontier = jax.device_put(
-            grown.reshape(D * new_Fl, W), self._row_sharding
+
+        def grow(rows, ebits):
+            # Local blocks [Fl, W] / [Fl]: append zero rows per shard.
+            return (
+                jnp.concatenate([rows, jnp.zeros((Fl, W), jnp.uint32)]),
+                jnp.concatenate([ebits, jnp.zeros((Fl,), jnp.uint32)]),
+            )
+
+        fn = self._shard_map(
+            grow,
+            in_specs=(P("shards", None), P("shards")),
+            out_specs=(P("shards", None), P("shards")),
         )
-        self._frontier_ebits = jax.device_put(
-            gebits.reshape(D * new_Fl), self._plane_sharding
+        self._frontier, self._frontier_ebits = fn(
+            self._frontier, self._frontier_ebits
         )
         self._Fl = new_Fl
         local_cand = self._Fl * self._A
