@@ -16,6 +16,18 @@ equivalents are collectives over the ICI mesh (SURVEY.md §2.8):
 Because children live wherever their fingerprint lands, frontier load
 balances itself by hash uniformity — the data-parallel replacement for the
 reference's work-sharing job market.
+
+**Beyond one host**: the engine is expressed entirely as ``shard_map`` over
+a one-axis ``Mesh``, so the multi-host path is JAX's standard one — call
+``jax.distributed.initialize()`` on every process, build the mesh over
+``jax.devices()`` (all hosts' chips), and the same programs run with XLA
+routing the ``all_to_all``/``psum`` over ICI within a slice and DCN across
+slices. The host-side driver state (counters, found-name pinning, growth
+decisions) is derived from replicated scalars, so every controller process
+takes identical decisions. Single-host multi-chip is what CI validates (the
+8-device virtual CPU mesh in tests/conftest.py and the driver's
+``dryrun_multichip``); true multi-host needs hardware this container does
+not have.
 """
 
 from .sharded import ShardedXlaChecker, default_mesh
