@@ -189,6 +189,31 @@ class Actor:
         return type(self).__name__
 
 
+class ScriptActor(Actor):
+    """Sends a series of ``(Id, msg)`` pairs in sequence, waiting for a
+    message delivery between each — useful for driving actor systems in
+    tests. The duck-typed rendering of the reference's ``Actor`` impl for
+    ``Vec<(Id, Msg)>`` (actor.rs:495-527); state is the next script index.
+    """
+
+    def __init__(self, script):
+        self.script = list(script)
+
+    def on_start(self, id: Id, out: Out) -> int:
+        if self.script:
+            dst, msg = self.script[0]
+            out.send(dst, msg)
+            return 1
+        return 0
+
+    def on_msg(self, id: Id, state: StateRef, src: Id, msg: Any, out: Out) -> None:
+        i = state.get()
+        if i < len(self.script):
+            dst, nxt = self.script[i]
+            out.send(dst, nxt)
+            state.set(i + 1)
+
+
 from .model import (  # noqa: E402  (re-exports, mirroring actor.rs:99-106)
     ActorModel,
     ActorModelAction,
@@ -211,6 +236,7 @@ __all__ = [
     "Id",
     "Network",
     "Out",
+    "ScriptActor",
     "Send",
     "SetTimer",
     "StateRef",

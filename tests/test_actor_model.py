@@ -313,3 +313,30 @@ def test_heterogeneous_actor_systems_via_duck_typing():
     )
     checker.assert_properties()
     assert checker.discovery("client done").last_state().actor_states == (1, "done")
+
+
+def test_script_actor_drives_system():
+    """ScriptActor sends its pairs in sequence, one per delivery
+    (actor.rs:495-527): against an echo server, a 2-message script reaches
+    index 2 with both replies delivered."""
+    from stateright_tpu.actor import Actor, ActorModel, Id, Network, ScriptActor
+    from stateright_tpu.core import Expectation
+
+    class Echo(Actor):
+        def on_start(self, id, out):
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            state.set(state.get() + 1)
+            out.send(src, ("echo", msg))
+
+    model = ActorModel(cfg=None)
+    model.actor(Echo())
+    model.actor(ScriptActor([(Id(0), "a"), (Id(0), "b")]))
+    model = model.init_network(Network.new_unordered_nonduplicating()).property(
+        Expectation.SOMETIMES,
+        "script done",
+        lambda _m, s: s.actor_states[1] == 2 and s.actor_states[0] == 2,
+    )
+    checker = model.checker().spawn_bfs().join()
+    checker.assert_properties()
