@@ -234,33 +234,9 @@ def _worker(platform: str) -> None:
         f"coverage) in {elapsed:.2f}s -> {value:,.0f} states/s"
     )
 
-    matrix = []
-    if os.environ.get("BENCH_MATRIX", "1") != "0":
-        try:
-            matrix = _run_matrix(platform)
-        except Exception as e:  # the primary metric line must survive
-            _log(f"matrix runner FAILED: {type(e).__name__}: {e}")
-            matrix = [{"error": f"{type(e).__name__}: {e}"}]
-
-    with open(os.path.join(REPO, "bench_detail.json"), "w") as fh:
-        json.dump(
-            {
-                "platform": platform,
-                "rm": rm,
-                "generated_states": states,
-                "unique_states": checker.unique_state_count(),
-                "max_depth": checker.max_depth(),
-                "warm_pass_sec": round(warm_sec, 3),
-                "measured_sec": round(elapsed, 3),
-                "full_coverage": completed,
-                "states_per_sec": round(value, 1),
-                "levels": detail,
-                "matrix": matrix,
-            },
-            fh,
-            indent=1,
-        )
-
+    # The primary metric line goes out IMMEDIATELY: the matrix below may
+    # outlive the parent's watchdog, and a killed worker must not take the
+    # already-measured number with it (the parent salvages stdout).
     print(
         json.dumps(
             {
@@ -273,11 +249,50 @@ def _worker(platform: str) -> None:
         flush=True,
     )
 
+    def write_detail(matrix):
+        with open(os.path.join(REPO, "bench_detail.json"), "w") as fh:
+            json.dump(
+                {
+                    "platform": platform,
+                    "rm": rm,
+                    "generated_states": states,
+                    "unique_states": checker.unique_state_count(),
+                    "max_depth": checker.max_depth(),
+                    "warm_pass_sec": round(warm_sec, 3),
+                    "measured_sec": round(elapsed, 3),
+                    "full_coverage": completed,
+                    "states_per_sec": round(value, 1),
+                    "levels": detail,
+                    "matrix": matrix,
+                },
+                fh,
+                indent=1,
+            )
+
+    # Write the detail now (sans matrix) so a watchdog kill mid-matrix
+    # cannot lose it, then rewrite with the matrix rows.
+    write_detail([{"note": "matrix still running (or killed mid-run)"}])
+    matrix = []
+    if os.environ.get("BENCH_MATRIX", "1") != "0":
+        try:
+            matrix = _run_matrix(platform)
+        except Exception as e:  # the primary metric line must survive
+            _log(f"matrix runner FAILED: {type(e).__name__}: {e}")
+            matrix = [{"error": f"{type(e).__name__}: {e}"}]
+    write_detail(matrix)
+
+
+def _json_lines(text) -> list:
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    return [l for l in (text or "").splitlines() if l.strip().startswith("{")]
+
 
 def _spawn_worker(platform: str, timeout_s: float) -> str | None:
     """Runs ``bench.py --worker <platform>`` under a hard timeout; returns
-    the worker's final JSON line or None. The worker's stderr streams to
-    ours (it logs to bench_probe.log itself)."""
+    the worker's primary JSON line or None. A worker killed by the watchdog
+    mid-matrix still counts as success if it printed the primary line first.
+    The worker's stderr streams to ours (it logs to bench_probe.log)."""
     t0 = time.monotonic()
     try:
         proc = subprocess.run(
@@ -287,16 +302,23 @@ def _spawn_worker(platform: str, timeout_s: float) -> str | None:
             text=True,
             cwd=REPO,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        salvage = _json_lines(e.stdout)
+        if salvage:
+            _log(
+                f"{platform} worker killed at {timeout_s:.0f}s but the "
+                "primary metric was already out; using it"
+            )
+            return salvage[0]
         _log(f"{platform} worker WEDGED/timed out after {timeout_s:.0f}s; killed")
         return None
     dt = time.monotonic() - t0
-    lines = [l for l in (proc.stdout or "").splitlines() if l.strip().startswith("{")]
+    lines = _json_lines(proc.stdout)
     if proc.returncode != 0 or not lines:
         _log(f"{platform} worker rc={proc.returncode} in {dt:.0f}s, no JSON line")
         return None
     _log(f"{platform} worker ok in {dt:.0f}s")
-    return lines[-1]
+    return lines[0]
 
 
 def main() -> None:
