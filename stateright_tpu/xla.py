@@ -320,7 +320,7 @@ class XlaChecker(Checker):
 
     # --- the fused super-step ---------------------------------------------
 
-    def _build_superstep(self, f_cap: int):
+    def _build_superstep(self, f_cap: int, cand_cap: int):
         import jax
         import jax.numpy as jnp
 
@@ -335,6 +335,21 @@ class XlaChecker(Checker):
 
         def dedup_words(words):
             return model.packed_representative(words) if symmetry else words
+
+        def compact(mask, cap, arrays):
+            """Stream-compact rows where ``mask`` holds into ``cap``-row
+            buffers (stable: original order preserved); rows beyond ``cap``
+            are routed to an out-of-range index and dropped. Returns
+            ``(compacted arrays, count)`` where ``count`` is the TOTAL mask
+            population — count > cap means truncation (the caller's
+            overflow signal)."""
+            pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+            idx = jnp.where(mask & (pos < cap), pos, cap)
+            outs = [
+                jnp.zeros((cap,) + a.shape[1:], a.dtype).at[idx].set(a, mode="drop")
+                for a in arrays
+            ]
+            return outs, jnp.sum(mask, dtype=jnp.int32)
 
         def superstep(frontier, f_ebits, f_count, table, disc_found, disc_fp):
             f_valid = jnp.arange(f_cap) < f_count
@@ -359,23 +374,12 @@ class XlaChecker(Checker):
                 if i in hv_idx:
                     # Candidates only — the host confirms with the exact
                     # condition before anything becomes a discovery.
-                    pos = jnp.cumsum(viol.astype(jnp.int32)) - 1
-                    cidx = jnp.where(viol & (pos < hv_cap), pos, hv_cap)
-                    cw = (
-                        jnp.zeros((hv_cap, W), jnp.uint32)
-                        .at[cidx]
-                        .set(frontier, mode="drop")
-                    )
-                    cf = (
-                        jnp.zeros((hv_cap, 2), jnp.uint32)
-                        .at[cidx, 0]
-                        .set(fhi, mode="drop")
-                        .at[cidx, 1]
-                        .set(flo, mode="drop")
+                    (cw, cf), n_viol = compact(
+                        viol, hv_cap, [frontier, jnp.stack([fhi, flo], axis=1)]
                     )
                     hv_words_out.append(cw)
                     hv_fp_out.append(cf)
-                    hv_count_out.append(jnp.sum(viol, dtype=jnp.int32))
+                    hv_count_out.append(n_viol)
                     continue
                 has = jnp.any(viol)
                 first = jnp.argmax(viol)
@@ -407,16 +411,28 @@ class XlaChecker(Checker):
             valid = valid & f_valid[:, None]
             step_states = jnp.sum(valid, dtype=jnp.int32)
 
-            # 3. fingerprint candidates.
+            # 3. compact valid candidates (typically a minority of the F*A
+            #    grid — disabled slots are padding) into a tight buffer, so
+            #    canonicalization, fingerprinting, and the hash insert all
+            #    scale with real candidates instead of grid lanes.
             cand = nxt.reshape(f_cap * A, W)
-            cdw = jax.vmap(dedup_words)(cand)
-            chi, clo = fphash.fingerprint_words(cdw, jnp)
+            vmask = valid.reshape(-1)
             par_hi = jnp.broadcast_to(fhi[:, None], (f_cap, A)).reshape(-1)
             par_lo = jnp.broadcast_to(flo[:, None], (f_cap, A)).reshape(-1)
+            child_ebits = jnp.broadcast_to(f_ebits[:, None], (f_cap, A)).reshape(-1)
+            (ccand, cpar_hi, cpar_lo, cebits), n_valid = compact(
+                vmask, cand_cap, [cand, par_hi, par_lo, child_ebits]
+            )
+            cvalid = jnp.arange(cand_cap) < n_valid
+            cand_overflow = n_valid > cand_cap
+            cdw = jax.vmap(dedup_words)(ccand)
+            chi, clo = fphash.fingerprint_words(cdw, jnp)
 
-            # 4. dedup against the visited set.
+            # 4. dedup against the visited set. Compaction preserves lane
+            #    order, so the insert's lowest-index winner election picks
+            #    the same candidate it would have picked uncompacted.
             table, is_new, ovf = hashset.insert(
-                table, chi, clo, par_hi, par_lo, valid.reshape(-1), max_probes=max_probes
+                table, chi, clo, cpar_hi, cpar_lo, cvalid, max_probes=max_probes
             )
             step_unique = jnp.sum(is_new, dtype=jnp.int32)
             table_overflow = jnp.any(ovf)
@@ -437,12 +453,9 @@ class XlaChecker(Checker):
                 disc_found = disc_found.at[i].set(disc_found[i] | has)
 
             # 6. stream-compact survivors into the next frontier.
-            child_ebits = jnp.broadcast_to(f_ebits[:, None], (f_cap, A)).reshape(-1)
-            pos = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-            new_count = jnp.sum(is_new, dtype=jnp.int32)
-            idx = jnp.where(is_new & (pos < f_cap), pos, f_cap)
-            new_frontier = jnp.zeros((f_cap, W), jnp.uint32).at[idx].set(cand, mode="drop")
-            new_ebits = jnp.zeros((f_cap,), jnp.uint32).at[idx].set(child_ebits, mode="drop")
+            (new_frontier, new_ebits), new_count = compact(
+                is_new, f_cap, [ccand, cebits]
+            )
             frontier_overflow = new_count > f_cap
 
             return (
@@ -457,6 +470,7 @@ class XlaChecker(Checker):
                 table_overflow,
                 frontier_overflow,
                 codec_overflow,
+                cand_overflow,
                 hv_words,
                 hv_fps,
                 hv_counts,
@@ -464,7 +478,7 @@ class XlaChecker(Checker):
 
         return superstep
 
-    def _build_fused(self, f_cap: int):
+    def _build_fused(self, f_cap: int, cand_cap: int):
         """The level loop as a device program: a ``lax.while_loop`` around
         the superstep that commits one BFS level per iteration and exits on
         (a) the level budget, (b) frontier exhaustion, (c) any overflow —
@@ -478,7 +492,7 @@ class XlaChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        superstep = self._build_superstep(f_cap)
+        superstep = self._build_superstep(f_cap, cand_cap)
         W = self._W
         n_hv = len(self._hv_idx)
         hv_cap = self._hv_cap
@@ -526,10 +540,10 @@ class XlaChecker(Checker):
                 (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
                  disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c) = carry
                 (nf, ne, ncount, ntable, ndfound, ndfp, d_states, d_unique,
-                 t_ovf, f_ovf, c_ovf, lw, lf, lc) = superstep(
+                 t_ovf, f_ovf, c_ovf, cc_ovf, lw, lf, lc) = superstep(
                     frontier, f_ebits, f_count, table, disc_found, disc_fp
                 )
-                any_ovf = t_ovf | f_ovf | c_ovf
+                any_ovf = t_ovf | f_ovf | c_ovf | cc_ovf
                 commit = ~any_ovf
                 sel = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(commit, a, b), new, old
@@ -560,7 +574,7 @@ class XlaChecker(Checker):
                     sel(ndfp, disc_fp),
                     tot_states + jnp.where(commit, d_states, 0),
                     tot_unique + jnp.where(commit, d_unique, 0),
-                    jnp.stack([t_ovf, f_ovf, c_ovf]),
+                    jnp.stack([t_ovf, f_ovf, c_ovf, cc_ovf]),
                     hv_w,
                     hv_f,
                     hv_c,
@@ -577,7 +591,7 @@ class XlaChecker(Checker):
                 disc_fp,
                 jnp.int32(0),
                 jnp.int32(0),
-                jnp.zeros((3,), jnp.bool_),
+                jnp.zeros((4,), jnp.bool_),
                 jnp.zeros((n_hv, hv_cap, W), jnp.uint32),
                 jnp.zeros((n_hv, hv_cap, 2), jnp.uint32),
                 jnp.zeros((n_hv,), jnp.int32),
@@ -587,23 +601,60 @@ class XlaChecker(Checker):
 
         return fused
 
+    def _cand_cap_for(self, run_cap: int) -> int:
+        """Candidate-buffer capacity for a run bucket: a quarter of the
+        action grid (valid slots are typically a minority), power-of-four
+        bucketed, grown on overflow. Cached per model so repeated checkers
+        keep learned capacities alongside the compiled programs."""
+        caps = self._model.__dict__.setdefault("_xla_cand_caps", {})
+        cap = caps.get(run_cap)
+        if cap is None:
+            m = run_cap * self._A
+            cap = 1024
+            while cap < m // 4:
+                cap *= 4
+            caps[run_cap] = cap = min(cap, self._next_pow2(m))
+        return cap
+
+    @staticmethod
+    def _next_pow2(n: int) -> int:
+        return 1 << max(n - 1, 1).bit_length()
+
+    def _grow_cand_cap(self, run_cap: int) -> None:
+        caps = self._model.__dict__.setdefault("_xla_cand_caps", {})
+        m = run_cap * self._A
+        old = self._cand_cap_for(run_cap)
+        caps[run_cap] = min(old * 4, self._next_pow2(m))
+        # Evict the outgrown bucket's compiled programs — they can never be
+        # hit again (lookups always use the current cand cap) and each one
+        # holds a full XLA executable.
+        for key in [
+            k
+            for k in self._superstep_cache
+            if (k[0] == run_cap and k[1] == old)
+            or (k[0] == "fused" and k[1] == run_cap and k[2] == old)
+        ]:
+            del self._superstep_cache[key]
+
     def _superstep_for(self, f_cap: int):
         import jax
 
-        key = (f_cap, self._symmetry, self._max_probes)
+        cand_cap = self._cand_cap_for(f_cap)
+        key = (f_cap, cand_cap, self._symmetry, self._max_probes)
         fn = self._superstep_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._build_superstep(f_cap))
+            fn = jax.jit(self._build_superstep(f_cap, cand_cap))
             self._superstep_cache[key] = fn
         return fn
 
     def _fused_for(self, f_cap: int):
         import jax
 
-        key = ("fused", f_cap, self._symmetry, self._max_probes)
+        cand_cap = self._cand_cap_for(f_cap)
+        key = ("fused", f_cap, cand_cap, self._symmetry, self._max_probes)
         fn = self._superstep_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._build_fused(f_cap))
+            fn = jax.jit(self._build_fused(f_cap, cand_cap))
             self._superstep_cache[key] = fn
         return fn
 
@@ -774,7 +825,7 @@ class XlaChecker(Checker):
             ):
                 self._target_reached = True
                 return
-            t_ovf, f_ovf, c_ovf = (bool(x) for x in np.asarray(ovf))
+            t_ovf, f_ovf, c_ovf, cc_ovf = (bool(x) for x in np.asarray(ovf))
             if c_ovf:
                 self._raise_codec_overflow()
             if t_ovf:
@@ -782,6 +833,9 @@ class XlaChecker(Checker):
                 continue
             if f_ovf:
                 run_cap = self._grow_frontier(run_cap)
+                continue
+            if cc_ovf:
+                self._grow_cand_cap(run_cap)
                 continue
             if self._frontier_count == 0 or committed == 0:
                 break
@@ -848,6 +902,7 @@ class XlaChecker(Checker):
                 t_ovf,
                 f_ovf,
                 c_ovf,
+                cc_ovf,
                 hv_words,
                 hv_fps,
                 hv_counts,
@@ -861,6 +916,9 @@ class XlaChecker(Checker):
                 continue
             if bool(f_ovf):
                 run_cap = self._grow_frontier(run_cap)
+                continue
+            if bool(cc_ovf):
+                self._grow_cand_cap(run_cap)
                 continue
             break
 
