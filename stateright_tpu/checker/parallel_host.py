@@ -218,6 +218,8 @@ class ParallelBfsChecker(Checker):
         self._to_main = [r for r, _ in to_main_pipes]
         self._from_main = [w for _, w in from_main_pipes]
         self._workers = []
+        import warnings
+
         for k in range(n):
             p = ctx.Process(
                 target=_worker_main,
@@ -235,7 +237,20 @@ class ParallelBfsChecker(Checker):
                 ),
                 daemon=True,
             )
-            p.start()
+            with warnings.catch_warnings():
+                # JAX registers an at-fork hook that warns (RuntimeWarning)
+                # because its runtime threads live in the parent. The fork
+                # is deliberate — it is what lets lambda-bearing models
+                # cross into workers without pickling — and the children
+                # never touch JAX, so the feared deadlock cannot involve
+                # them.
+                warnings.filterwarnings(
+                    "ignore", message=".*fork.*", category=RuntimeWarning
+                )
+                warnings.filterwarnings(
+                    "ignore", message=".*fork.*", category=DeprecationWarning
+                )
+                p.start()
             self._workers.append(p)
 
         # Seed the initial frontier shards (bfs.rs:52-78).
