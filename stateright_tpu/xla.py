@@ -610,9 +610,9 @@ class XlaChecker(Checker):
         cap = caps.get(run_cap)
         if cap is None:
             m = run_cap * self._A
-            cap = 1024
-            while cap < m // 4:
-                cap *= 4
+            # Power-of-two (not four): a pow4 ladder can land just above
+            # m/4 at the big buckets and erase most of the compaction win.
+            cap = max(1024, self._next_pow2(max(m // 4, 1)))
             caps[run_cap] = cap = min(cap, self._next_pow2(m))
         return cap
 
