@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# The TPU measurement plan, one command, each stage under its own watchdog.
+# Run when the axon tunnel recovers (probe first). Stages:
+#  1. probe            — is the chip reachable at all?
+#  2. microbench       — dispatch RTT, superstep compile/steady per bucket,
+#                        hashset insert vs two-key sort (the hash-scatter vs
+#                        sort-dedup design decision), compaction styles.
+#  3. pallas check     — does the opt-in Pallas insert lower on hardware?
+#  4. bench            — the full primary metric + config matrix.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[tpu_plan $(date +%H:%M:%S)] $*"; }
+
+log "stage 1: probe"
+if ! timeout 60 python -c "import jax; ds=jax.devices(); print(ds); assert ds[0].platform=='tpu'"; then
+  log "tunnel not reachable; aborting"
+  exit 1
+fi
+
+log "stage 2: microbench (results -> tpu_microbench.log)"
+timeout 1800 python tools/microbench.py 6 2>&1 | tee tpu_microbench.log
+
+log "stage 3: compiled Pallas insert probe"
+timeout 600 python - <<'EOF' 2>&1 | tee tpu_pallas.log
+import numpy as np
+import jax, jax.numpy as jnp
+from stateright_tpu.ops import hashset
+from stateright_tpu.ops.pallas_hashset import insert_pallas
+hs = hashset.make(1 << 16, jnp)
+rng = np.random.default_rng(0)
+m = 256
+hi = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+lo = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+act = jnp.ones((m,), bool)
+try:
+    hs2, is_new, ovf = insert_pallas(hs, hi, lo, hi, lo, act, interpret=False)
+    ref, ref_new, ref_ovf = hashset.insert(hs, hi, lo, hi, lo, act)
+    ok = bool(jnp.all(is_new == ref_new)) and not bool(jnp.any(ovf))
+    print("pallas compiled insert:", "MATCHES XLA insert" if ok else "DIVERGES")
+except Exception as e:
+    print(f"pallas compiled insert FAILED to lower/run: {type(e).__name__}: {e}")
+EOF
+
+log "stage 4: full bench"
+python bench.py
+log "done; see BENCH output above, bench_detail.json, bench_probe.log"
