@@ -29,6 +29,7 @@ from ..actor import (
 from ..actor.network import Envelope
 from ..actor.timers import Timers
 from ..core import Expectation
+from ..packing import PackedModelAdapter
 from ..utils.variant import variant
 
 Ping = variant("Ping", [])
@@ -90,7 +91,7 @@ def timers_model(
     )
 
 
-class PackedTimers:
+class PackedTimers(PackedModelAdapter):
     """The Pingers system on the device engine (``spawn_xla``) — timers on
     device, completing device-engine coverage of every reference example.
 
@@ -141,15 +142,8 @@ class PackedTimers:
             for parity in (0, 1)
         }
 
-    # --- object-level Model API --------------------------------------------
-
-    def checker(self):
-        from ..checker.builder import CheckerBuilder
-
-        return CheckerBuilder(self)
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
+    # object-level Model API: inherited from PackedModelAdapter, which
+    # resolves it against ``self._inner``.
 
     # --- codec --------------------------------------------------------------
 
@@ -201,11 +195,6 @@ class PackedTimers:
             timers_set=tuple(timers for _ in range(self.n)),
             history=(),
         )
-
-    def packed_init(self):
-        import numpy as np
-
-        return np.stack([self.pack(s) for s in self._inner.init_states()])
 
     # --- device kernels ------------------------------------------------------
 

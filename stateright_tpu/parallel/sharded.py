@@ -838,6 +838,14 @@ class ShardedXlaChecker(Checker):
             return False
         return True
 
+    def _raise_codec_overflow(self) -> None:
+        raise RuntimeError(
+            f"{type(self._model).__name__}: packed-codec capacity "
+            "overflow — a reachable successor does not fit the "
+            "model's declared field widths/slot counts (see "
+            "stateright_tpu.packing)."
+        )
+
     def _pin_found_names(self) -> None:
         found = np.asarray(self._disc_found)
         fps = np.asarray(self._disc_fp)
@@ -912,12 +920,7 @@ class ShardedXlaChecker(Checker):
                 return
             t_ovf, f_ovf, r_ovf, c_ovf = (bool(x) for x in np.asarray(ovf))
             if c_ovf:
-                raise RuntimeError(
-                    f"{type(self._model).__name__}: packed-codec capacity "
-                    "overflow — a reachable successor does not fit the "
-                    "model's declared field widths/slot counts (see "
-                    "stateright_tpu.packing)."
-                )
+                self._raise_codec_overflow()
             if t_ovf:
                 self._grow_table()
                 continue
@@ -957,12 +960,7 @@ class ShardedXlaChecker(Checker):
             (nf, ne, ncounts, table, dfound, dfp, d_states, d_unique,
              t_ovf, f_ovf, r_ovf, c_ovf) = out
             if bool(np.asarray(c_ovf)):
-                raise RuntimeError(
-                    f"{type(self._model).__name__}: packed-codec capacity "
-                    "overflow — a reachable successor does not fit the "
-                    "model's declared field widths/slot counts (see "
-                    "stateright_tpu.packing)."
-                )
+                self._raise_codec_overflow()
             if bool(np.asarray(t_ovf)):
                 self._grow_table()
                 continue

@@ -743,23 +743,31 @@ class XlaChecker(Checker):
             return self._run_block_fused()
         return self._run_block_single()
 
+    def _entry_checks(self) -> bool:
+        """Shared dispatch preamble; returns False when nothing to run.
+        Mirrors the dequeue-time depth bookkeeping (bfs.rs:257-272): a
+        frontier at the target depth is counted in max_depth but skipped."""
+        if self._target_reached or self._exhausted:
+            return False
+        if self._P > 0 and all(n in self._found_names for n in self._prop_names):
+            return False
+        if self._frontier_count == 0:
+            self._exhausted = True
+            return False
+        self._max_depth = max(self._max_depth, self._depth)
+        if self._target_max_depth is not None and self._depth >= self._target_max_depth:
+            self._frontier_count = 0
+            self._exhausted = True
+            return False
+        return True
+
     def _run_block_fused(self) -> None:
         """Up to ``levels_per_dispatch`` BFS levels in one device call (see
         ``_build_fused``). Overflow exits commit every level before the
         overflowing one, grow, and re-enter with the remaining budget."""
         import jax.numpy as jnp
 
-        if self._target_reached or self._exhausted:
-            return
-        if all(name in self._found_names for name in self._prop_names) and self._P > 0:
-            return
-        if self._frontier_count == 0:
-            self._exhausted = True
-            return
-        self._max_depth = max(self._max_depth, self._depth)
-        if self._target_max_depth is not None and self._depth >= self._target_max_depth:
-            self._frontier_count = 0
-            self._exhausted = True
+        if not self._entry_checks():
             return
 
         budget_left = self._levels_per_dispatch
@@ -849,20 +857,7 @@ class XlaChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        if self._target_reached or self._exhausted:
-            return
-        if all(name in self._found_names for name in self._prop_names) and self._P > 0:
-            return
-        if self._frontier_count == 0:
-            self._exhausted = True
-            return
-        # Depth bookkeeping mirrors the dequeue-time update (bfs.rs:257-265);
-        # a frontier at the target depth is skipped, not expanded
-        # (bfs.rs:267-272).
-        self._max_depth = max(self._max_depth, self._depth)
-        if self._target_max_depth is not None and self._depth >= self._target_max_depth:
-            self._frontier_count = 0
-            self._exhausted = True
+        if not self._entry_checks():
             return
 
         if self._visitor is not None:
