@@ -44,9 +44,16 @@ def json_codec(*msg_types: type):
     by_name = {t.__name__: t for t in msg_types}
 
     def _enc(v: Any) -> Any:
+        from . import Id
+
         t = type(v)
         if t.__name__ in by_name and isinstance(v, tuple):
             return {"@": t.__name__, "f": [_enc(x) for x in v]}
+        if t is Id:
+            # Framework type, handled natively: actor ids ride inside
+            # protocol payloads (Paxos ballots, ABD sequencers) the same
+            # way the reference's serde serializes its u64 newtype.
+            return {"@": "__id__", "f": int(v)}
         if t is tuple:
             return {"@": "__tuple__", "f": [_enc(x) for x in v]}
         if t in (set, frozenset):
@@ -68,6 +75,10 @@ def json_codec(*msg_types: type):
             return [_dec(x) for x in v]
         if isinstance(v, dict):
             tag, fields = v["@"], v["f"]
+            if tag == "__id__":
+                from . import Id
+
+                return Id(fields)
             if tag == "__tuple__":
                 return tuple(_dec(x) for x in fields)
             if tag == "__set__":
