@@ -58,3 +58,27 @@ class TestJsonCodec:
         ]:
             got = de(ser(msg))
             assert got == msg and type(got) is type(msg)
+
+
+def test_wire_codec_id_in_protocol_payloads():
+    """Ids ride inside protocol tuples (Paxos ballots, ABD sequencers) and
+    must round-trip the wire codec natively (regression: the spawn CLIs
+    crashed on their first internal broadcast without this)."""
+    from stateright_tpu.actor import Id
+    from stateright_tpu.actor import register as reg
+    from stateright_tpu.actor.spawn import json_codec
+    from stateright_tpu.models.linearizable_register import AckQuery, Query
+    from stateright_tpu.models.paxos import Prepare
+
+    ser, de = json_codec(reg.Internal, Prepare, Query, AckQuery)
+    some_id = Id.from_addr("127.0.0.1", 3001)
+    for msg in [
+        reg.Internal(Prepare((1, some_id))),
+        reg.Internal(AckQuery(7, (3, some_id), "V")),
+    ]:
+        back = de(ser(msg))
+        assert back == msg
+        # The Id must come back as an Id (addr codec still usable), not int.
+        inner = back.msg
+        seq = inner.ballot if hasattr(inner, "ballot") else inner.seq
+        assert isinstance(seq[1], Id)
