@@ -123,3 +123,41 @@ def test_recent_path_snapshot_populates():
     app.run_to_completion()
     app.drive()
     assert app.status()["recent_path"] is not None
+
+
+def test_explorer_live_socket_smoke():
+    """One real HTTP round-trip: bind a loopback server on an ephemeral
+    port, GET /.status and a state page, assert the JSON contract — the
+    live-socket complement to the framework-free handler tests."""
+    import json
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from stateright_tpu.checker.explorer import _ExplorerHandler, make_app
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    app, checker = make_app(TwoPhaseSys(2).checker())
+
+    class Handler(_ExplorerHandler):
+        explorer_app = app
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/.status", timeout=5
+        ) as resp:
+            status = json.load(resp)
+        assert status["model"] == "TwoPhaseSys"
+        assert "consistent" in [p[1] for p in status["properties"]]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/.states/", timeout=5
+        ) as resp:
+            states = json.load(resp)
+        assert len(states) == 1  # the single 2pc init state
+    finally:
+        server.shutdown()
+        t.join(timeout=5)
