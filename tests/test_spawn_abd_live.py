@@ -1,0 +1,81 @@
+"""Live UDP ABD cluster: three quorum-register replicas + a driver client.
+
+Same runtime-proof pattern as the live Paxos test: the model-checked
+AbdActor binds real sockets, runs both ABD phases (quorum Query, quorum
+Record) over loopback UDP, and serves a read whose value equals the write.
+Writes and reads are driven at DIFFERENT replicas, so the read's quorum
+must intersect the write's — the actual ABD property.
+"""
+
+import threading
+
+from stateright_tpu.actor import Id
+from stateright_tpu.actor import register as reg
+from stateright_tpu.actor.spawn import json_codec, spawn
+from stateright_tpu.models.linearizable_register import (
+    AbdActor,
+    AckQuery,
+    AckRecord,
+    Query,
+    Record,
+)
+
+
+class Driver:
+    """Put at one replica, then Get at another, with resend guards."""
+
+    def __init__(self, put_at, get_at, record, done):
+        self.put_at = put_at
+        self.get_at = get_at
+        self.record = record
+        self.done = done
+
+    def on_start(self, id, out):
+        out.set_timer("kick", (0.05, 0.05))
+        return "put"
+
+    def on_timeout(self, id, state, timer, out):
+        phase = state.get()
+        if phase == "put":
+            out.send(self.put_at, reg.Put(1, "X"))
+        elif phase == "get":
+            out.send(self.get_at, reg.Get(2))
+        if phase != "done":
+            out.set_timer("kick", (0.5, 0.5))
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, reg.PutOk) and state.get() == "put":
+            state.set("get")
+            out.send(self.get_at, reg.Get(2))
+        elif isinstance(msg, reg.GetOk) and state.get() == "get":
+            self.record.append(msg.value)
+            state.set("done")
+            out.cancel_timer("kick")
+            self.done.set()
+
+
+def test_live_abd_cluster_read_sees_write_across_replicas():
+    base = 28600
+    ids = [Id.from_addr("127.0.0.1", base + i) for i in range(4)]
+    servers, client = ids[:3], ids[3]
+    serialize, deserialize = json_codec(
+        reg.Put, reg.Get, reg.PutOk, reg.GetOk, reg.Internal,
+        Query, AckQuery, Record, AckRecord,
+    )
+    record: list = []
+    done = threading.Event()
+    handles = spawn(
+        serialize,
+        deserialize,
+        [(i, AbdActor([x for x in servers if x != i])) for i in servers]
+        + [(client, Driver(servers[0], servers[2], record, done))],
+        background=True,
+    )
+    try:
+        assert done.wait(timeout=15), "ABD cluster failed to serve within 15s"
+        assert record == ["X"]
+    finally:
+        for _thread, runtime in handles:
+            runtime.stopped.set()
+        for thread, _runtime in handles:
+            thread.join(timeout=5)
