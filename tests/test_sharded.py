@@ -145,3 +145,29 @@ def test_sharded_target_state_count():
     )
     assert checker.is_done()
     assert checker.state_count() >= 100
+
+
+def test_sharded_symmetry_reduction_matches_perfect_canonicalizer():
+    """Symmetry on the mesh engine: the racy increment's representative
+    (sorted thread tuples) is a PERFECT canonicalizer, so the reduced
+    count is exploration-order-invariant — host, single-chip, and sharded
+    engines must all see exactly 8 classes for 2 threads
+    (increment.rs:31-105)."""
+    from stateright_tpu.core import Property
+    from stateright_tpu.models.increment import Increment, PackedIncrement
+
+    class _Full(PackedIncrement):
+        def properties(self):
+            return [Property.sometimes("unreachable", lambda _m, _s: False)]
+
+        def packed_properties(self, words):
+            import jax.numpy as jnp
+
+            return jnp.stack([jnp.bool_(False)])
+
+    kw = dict(frontier_capacity=1 << 10, table_capacity=1 << 13)
+    single = _Full(2).checker().symmetry().spawn_xla(**kw).join()
+    sharded = _Full(2).checker().symmetry().spawn_xla(mesh=_mesh(), **kw).join()
+    assert single.unique_state_count() == 8
+    assert sharded.unique_state_count() == 8
+    assert sharded.state_count() == single.state_count()
