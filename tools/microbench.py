@@ -111,6 +111,26 @@ def main() -> None:
             flush=True,
         )
 
+    # 6. end-to-end amortization: warm full-coverage checks with the level
+    #    loop on device (fused, default) vs one level per dispatch — the
+    #    direct measurement of dispatch/tunnel-latency amortization.
+    for levels in (32, 1):
+        kw = dict(
+            frontier_capacity=1 << 17,
+            table_capacity=1 << 21,
+            levels_per_dispatch=levels,
+        )
+        model2 = PackedTwoPhaseSys(rm)
+        model2.checker().spawn_xla(**kw).join()  # warm/compile
+        t0 = time.monotonic()
+        c2 = model2.checker().spawn_xla(**kw).join()
+        dt = time.monotonic() - t0
+        print(
+            f"full check rm={rm} levels_per_dispatch={levels}: {dt:7.2f}s "
+            f"({c2.state_count()/dt/1e3:8.1f} k gen/s)",
+            flush=True,
+        )
+
     W = 4
     for pow2 in (17, 20):
         m = 1 << pow2
