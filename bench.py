@@ -213,7 +213,11 @@ def _worker(platform: str) -> None:
     # the model, so pass 2 reuses every bucket compilation from pass 1.
     model = PackedTwoPhaseSys(rm)
 
-    warm_budget = float(os.environ.get("BENCH_WARM_BUDGET_S", "600"))
+    # TPU warm passes pay one XLA compile per superstep bucket (~1 min each
+    # over the tunnel, ~6 buckets at rm=8) — the warm budget must cover them
+    # or the measured pass inherits the leftovers and reads artificially low.
+    default_warm = "600" if platform == "cpu" else "1500"
+    warm_budget = float(os.environ.get("BENCH_WARM_BUDGET_S", default_warm))
     measure_budget = float(os.environ.get("BENCH_MEASURE_BUDGET_S", "300"))
     spawn_kwargs = dict(
         frontier_capacity=1 << frontier_pow, table_capacity=1 << table_pow
