@@ -52,6 +52,30 @@ def _owner_of(fp: int, n: int) -> int:
     return (((fp * _OWNER_MULT) & _MASK64) >> 32) % n
 
 
+def _eval_properties(model, properties, state, fp, ebits, discoveries):
+    """Property evaluation at dequeue time (bfs.rs:279-328): returns the
+    state's updated eventually-bits, recording ALWAYS/SOMETIMES discoveries
+    into ``discoveries`` in place.
+
+    EVENTUALLY conditions must clear ebits even after that property has a
+    recorded discovery this level — skipping the clear would hand children
+    a stale eventually-bit and invent terminal counterexamples at deeper
+    levels.
+    """
+    for i, prop in enumerate(properties):
+        if prop.expectation == Expectation.EVENTUALLY:
+            if prop.condition(model, state):
+                ebits = ebits - {i}
+        elif i in discoveries:
+            continue
+        elif prop.expectation == Expectation.ALWAYS:
+            if not prop.condition(model, state):
+                discoveries[i] = fp
+        elif prop.condition(model, state):
+            discoveries[i] = fp
+    return ebits
+
+
 def _worker_main(rank, n, model, properties, symmetry, target_max_depth,
                  inbox, outboxes, to_main, from_main):
     """Worker loop: owns one shard of visited set / parent map / frontier.
@@ -114,18 +138,9 @@ def _worker_main(rank, n, model, properties, symmetry, target_max_depth,
                 # precedes the property pass).
                 if at_depth_target:
                     continue
-                # Property evaluation at dequeue time (bfs.rs:279-328).
-                for i, prop in enumerate(properties):
-                    if i in discoveries:
-                        continue
-                    if prop.expectation == Expectation.ALWAYS:
-                        if not prop.condition(model, state):
-                            discoveries[i] = fp
-                    elif prop.expectation == Expectation.SOMETIMES:
-                        if prop.condition(model, state):
-                            discoveries[i] = fp
-                    elif prop.condition(model, state):
-                        ebits = ebits - {i}
+                ebits = _eval_properties(
+                    model, properties, state, fp, ebits, discoveries
+                )
                 # Expansion (bfs.rs:330-381).
                 is_terminal = True
                 actions: List[Any] = []
@@ -369,6 +384,13 @@ class ParallelBfsChecker(Checker):
         *representative* fingerprint, which the main process cannot derive;
         chains are short and n is small, so query shards starting with the
         no-symmetry owner."""
+        if self._closed:
+            raise RuntimeError(
+                "worker pool already closed: close() preempted finalize, so "
+                "this discovery's witness path was never cached and the "
+                "parent-map shards that could rebuild it are gone. Let the "
+                "check finish (join()) before closing, or re-run it."
+            )
         guess = _owner_of(fp, self._n)
         order = [guess] + [j for j in range(self._n) if j != guess]
         for j in order:

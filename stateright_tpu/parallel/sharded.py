@@ -68,8 +68,8 @@ class ShardedXlaChecker(Checker):
         builder,
         mesh,
         *,
-        frontier_capacity: int = 1 << 15,
-        table_capacity: int = 1 << 20,
+        frontier_capacity: Optional[int] = None,
+        table_capacity: Optional[int] = None,
         route_capacity: Optional[int] = None,
         max_probes: int = 32,
         visit_cap: int = 4096,
@@ -125,9 +125,14 @@ class ShardedXlaChecker(Checker):
         # Capacities learned by earlier checkers of this model over a
         # same-size mesh (growth events) — start there instead of repeating
         # the growth.
+        # Same hint policy as the single-chip engine: hints may only raise
+        # DEFAULT capacities — an explicit request (even a smaller one, e.g.
+        # to exercise the growth path) wins over cross-checker state.
         hints = model.__dict__.get("_xla_sharded_cap_hints", {}).get(D, {})
-        frontier_capacity = max(frontier_capacity, hints.get("frontier", 0))
-        table_capacity = max(table_capacity, hints.get("table", 0))
+        if frontier_capacity is None:
+            frontier_capacity = max(1 << 15, hints.get("frontier", 0))
+        if table_capacity is None:
+            table_capacity = max(1 << 20, hints.get("table", 0))
         self._Fl = max(frontier_capacity // D, 16)  # frontier rows per shard
         self._Cl = max(table_capacity // D, 64)  # table slots per shard
         if self._Cl & (self._Cl - 1):
@@ -136,8 +141,14 @@ class ShardedXlaChecker(Checker):
         # shard's candidates evenly over destinations; 4x slack + retry on
         # overflow covers skew.
         local_cand = self._Fl * self._A
-        self._K = route_capacity or min(local_cand, max(64, (local_cand // D) * 4))
-        self._K = max(self._K, hints.get("route", 0))
+        if route_capacity is not None:
+            if route_capacity < 1:
+                # K=0 could never grow out of route overflow (growth doubles).
+                raise ValueError(f"route_capacity must be >= 1, got {route_capacity}")
+            self._K = route_capacity  # explicit request wins over the hint
+        else:
+            self._K = min(local_cand, max(64, (local_cand // D) * 4))
+            self._K = max(self._K, hints.get("route", 0))
 
         self._row_spec = P("shards", None)
         self._plane_spec = P("shards")

@@ -85,6 +85,19 @@ def _require_packed(model: Model) -> None:
         )
 
 
+def capacity_hints(model: Model) -> Dict[str, int]:
+    """Capacities learned from growth events in earlier single-chip checks
+    of ``model`` (empty if none grew). Hints auto-apply only to DEFAULT
+    capacities; a caller that passes explicit capacities but wants the
+    carryover merges these in itself (bench.py's measured pass does)."""
+    out: Dict[str, int] = {}
+    if "_xla_table_cap_hint" in model.__dict__:
+        out["table_capacity"] = model.__dict__["_xla_table_cap_hint"]
+    if "_xla_frontier_cap_hint" in model.__dict__:
+        out["frontier_capacity"] = model.__dict__["_xla_frontier_cap_hint"]
+    return out
+
+
 class XlaChecker(Checker):
     """Level-synchronous BFS on an accelerator. One ``_run_block`` = one
     frontier super-step (one BFS level)."""
@@ -93,8 +106,8 @@ class XlaChecker(Checker):
         self,
         builder,
         *,
-        frontier_capacity: int = 1 << 15,
-        table_capacity: int = 1 << 20,
+        frontier_capacity: Optional[int] = None,
+        table_capacity: Optional[int] = None,
         max_probes: int = 32,
         host_verified_cap: int = 128,
         visit_cap: int = 4096,
@@ -179,12 +192,17 @@ class XlaChecker(Checker):
         # Capacities learned by earlier checkers of this model (growth
         # events) — starting there skips the rehash-and-rerun the previous
         # run already paid (bench warm pass learns, measured pass reuses).
-        table_capacity = max(
-            table_capacity, model.__dict__.get("_xla_table_cap_hint", 0)
-        )
-        frontier_capacity = max(
-            frontier_capacity, model.__dict__.get("_xla_frontier_cap_hint", 0)
-        )
+        # Hints apply only when the caller took the defaults: an explicit
+        # capacity — even a smaller one, e.g. to exercise the growth path —
+        # must win over cross-checker state.
+        if table_capacity is None:
+            table_capacity = max(
+                1 << 20, model.__dict__.get("_xla_table_cap_hint", 0)
+            )
+        if frontier_capacity is None:
+            frontier_capacity = max(
+                1 << 15, model.__dict__.get("_xla_frontier_cap_hint", 0)
+            )
 
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.

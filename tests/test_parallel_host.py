@@ -116,6 +116,37 @@ def test_parallel_close_before_start_is_harmless():
     assert set(c.discoveries()) == {"abort agreement", "commit agreement"}
 
 
+def test_eval_properties_clears_ebits_after_discovery():
+    # Regression (unit-level, because the end-to-end effect is masked by the
+    # main process's per-name discovery dedup): a worker that records an
+    # EVENTUALLY discovery mid-level used to skip the ebit-clearing branch
+    # for LATER frontier states in the same level, so their children
+    # inherited a stale eventually-bit.
+    from stateright_tpu.checker.parallel_host import _eval_properties
+
+    props = [Property.eventually("odd", lambda _, s: s % 2 == 1)]
+    discoveries = {0: 0xDEAD}  # "odd" already discovered this level
+    ebits = _eval_properties(None, props, 3, 0xBEEF, frozenset({0}), discoveries)
+    assert ebits == frozenset()  # condition held -> bit must clear anyway
+    assert discoveries == {0: 0xDEAD}  # and the recorded witness is untouched
+    # A non-satisfying state keeps its bit.
+    ebits = _eval_properties(None, props, 2, 0xF00D, frozenset({0}), discoveries)
+    assert ebits == frozenset({0})
+
+
+def test_parallel_path_query_after_close_raises_descriptive():
+    # discoveries() for a fingerprint whose path was never cached must fail
+    # loudly once the pool is gone, not hang on a dead pipe.
+    c = TwoPhaseSys(3).checker().threads(2).spawn_bfs()
+    # Run levels until a discovery is recorded but the check is not done.
+    while not c._discoveries and not c.is_done():
+        c._run_block()
+    assert c._discoveries and not c.is_done()
+    c.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        c.discoveries()
+
+
 def test_parallel_symmetry_deterministic_and_sound():
     # Under symmetry reduction the visited-class count depends on which
     # class member continues the search (canonicalization is sound but

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Background tunnel watcher: probe the axon TPU tunnel until it answers,
+# then run the staged measurement plan (tools/tpu_plan.sh) once and exit.
+# All output -> tpu_watch.log. Probe itself is cheap (one import attempt);
+# the heavy stages only start after a successful probe.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch.log
+log() { echo "[tpu_watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+
+log "watcher started (pid $$)"
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
+    log "probe $attempt: TUNNEL UP — launching tpu_plan.sh"
+    bash tools/tpu_plan.sh >>"$LOG" 2>&1
+    rc=$?
+    log "tpu_plan.sh finished rc=$rc"
+    exit $rc
+  fi
+  log "probe $attempt: tunnel down"
+  sleep 540
+done
