@@ -204,6 +204,12 @@ class XlaChecker(Checker):
                 1 << 15, model.__dict__.get("_xla_frontier_cap_hint", 0)
             )
 
+        # Per-level telemetry ({depth, frontier, generated, unique} per
+        # committed BFS level) — populated by both dispatch paths so fused
+        # dispatch does not cost consumers (bench_detail.json) the
+        # per-level breakdown.
+        self.level_log: List[Dict[str, int]] = []
+
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.
             self._frontier_capacity = max(frontier_capacity, 16)
@@ -527,6 +533,10 @@ class XlaChecker(Checker):
         # Map property index -> (is_hv, hv position) for the resolution mask.
         hv_pos = {i: j for j, i in enumerate(self._hv_idx)}
         P = self._P
+        # Per-level telemetry slots (frontier width / generated / unique per
+        # committed level) — fused dispatch must not cost the bench its
+        # per-level breakdown. Static bound: the dispatch level budget.
+        L = self._levels_per_dispatch
 
         def fused(frontier, f_ebits, f_count, table, disc_found, disc_fp,
                   budget, remaining, host_found):
@@ -554,7 +564,8 @@ class XlaChecker(Checker):
 
             def cond(carry):
                 (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
-                 disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c) = carry
+                 disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c,
+                 lvl_frontier, lvl_states, lvl_unique) = carry
                 return (
                     (lvl < budget)
                     & (f_count > 0)
@@ -566,7 +577,8 @@ class XlaChecker(Checker):
 
             def body(carry):
                 (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
-                 disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c) = carry
+                 disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c,
+                 lvl_frontier, lvl_states, lvl_unique) = carry
                 (nf, ne, ncount, ntable, ndfound, ndfp, d_states, d_unique,
                  t_ovf, f_ovf, c_ovf, cc_ovf, lw, lf, lc) = superstep(
                     frontier, f_ebits, f_count, table, disc_found, disc_fp
@@ -576,6 +588,13 @@ class XlaChecker(Checker):
                 sel = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(commit, a, b), new, old
                 )
+                # Telemetry for this level, recorded only when committed
+                # (an uncommitted level is retried after growth): slot index
+                # L drops the write.
+                slot = jnp.where(commit, committed, L)
+                lvl_frontier = lvl_frontier.at[slot].set(f_count, mode="drop")
+                lvl_states = lvl_states.at[slot].set(d_states, mode="drop")
+                lvl_unique = lvl_unique.at[slot].set(d_unique, mode="drop")
                 # Append this level's host-verified candidates to the block
                 # accumulator (frontier order within a level, level order
                 # across the block — the confirmation order the one-level
@@ -606,6 +625,9 @@ class XlaChecker(Checker):
                     hv_w,
                     hv_f,
                     hv_c,
+                    lvl_frontier,
+                    lvl_states,
+                    lvl_unique,
                 )
 
             carry0 = (
@@ -623,6 +645,9 @@ class XlaChecker(Checker):
                 jnp.zeros((n_hv, hv_cap, W), jnp.uint32),
                 jnp.zeros((n_hv, hv_cap, 2), jnp.uint32),
                 jnp.zeros((n_hv,), jnp.int32),
+                jnp.zeros((L,), jnp.int32),
+                jnp.zeros((L,), jnp.int32),
+                jnp.zeros((L,), jnp.int32),
             )
             out = jax.lax.while_loop(cond, body, carry0)
             return out[1:]  # drop the raw level counter
@@ -832,6 +857,9 @@ class XlaChecker(Checker):
                 hv_w,
                 hv_f,
                 hv_c,
+                lvl_frontier,
+                lvl_states,
+                lvl_unique,
             ) = fn(
                 f_in,
                 e_in,
@@ -850,6 +878,19 @@ class XlaChecker(Checker):
             self._disc_found, self._disc_fp = dfound, dfp
             self._state_count += int(tot_states)
             self._unique_count += int(tot_unique)
+            if committed:
+                lvf = np.asarray(lvl_frontier)
+                lvs = np.asarray(lvl_states)
+                lvu = np.asarray(lvl_unique)
+                self.level_log.extend(
+                    {
+                        "depth": self._depth + i,
+                        "frontier": int(lvf[i]),
+                        "generated": int(lvs[i]),
+                        "unique": int(lvu[i]),
+                    }
+                    for i in range(committed)
+                )
             self._depth += committed
             if committed:
                 self._max_depth = max(self._max_depth, self._depth - 1)
@@ -947,6 +988,14 @@ class XlaChecker(Checker):
                 continue
             break
 
+        self.level_log.append(
+            {
+                "depth": self._depth,
+                "frontier": self._frontier_count,
+                "generated": int(d_states),
+                "unique": int(d_unique),
+            }
+        )
         self._frontier, self._frontier_ebits, self._table = nf, ne, table
         self._frontier_count = int(ncount)
         self._disc_found, self._disc_fp = dfound, dfp

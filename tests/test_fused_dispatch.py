@@ -39,6 +39,22 @@ def test_fused_matches_single_full_coverage():
     assert b.unique_state_count() == 288
 
 
+def test_fused_level_log_matches_single():
+    # Per-level telemetry must survive fused dispatch: identical
+    # {depth, frontier, generated, unique} rows to the one-level path, and
+    # rows must reconcile with the totals (inits are counted in totals but
+    # predate level 1).
+    a = _spawn(PackedTwoPhaseSys(3), 1, **KW).join()
+    b = _spawn(PackedTwoPhaseSys(3), 32, **KW).join()
+    assert b.level_log == a.level_log
+    # One row per expanded level, depths 1..max_depth (the last expansion
+    # finds nothing new but is itself a row).
+    assert [r["depth"] for r in b.level_log] == list(range(1, b.max_depth() + 1))
+    n_init = 1
+    assert sum(r["generated"] for r in b.level_log) + n_init == b.state_count()
+    assert sum(r["unique"] for r in b.level_log) + n_init == b.unique_state_count()
+
+
 def test_fused_matches_single_early_exit():
     # An eventually-property counterexample (terminal even node) plus a
     # long tail: exercises the on-device terminal detection and the
