@@ -59,11 +59,15 @@ def save_checkpoint(checker, path: str) -> None:
     """Writes the checker's logical search state. Valid after any number of
     ``_run_block`` calls (between super-steps the device state is quiescent).
     """
+    # The sharded engine's planes can span non-addressable devices under
+    # jax.distributed; its _host_read allgathers them. Single-chip arrays
+    # are process-local, so plain np.asarray suffices there.
+    read = getattr(checker, "_host_read", np.asarray)
     table = checker._table
-    kh = np.asarray(table.key_hi)
-    kl = np.asarray(table.key_lo)
-    vh = np.asarray(table.val_hi)
-    vl = np.asarray(table.val_lo)
+    kh = read(table.key_hi)
+    kl = read(table.key_lo)
+    vh = read(table.val_hi)
+    vl = read(table.val_lo)
     occ = (kh != 0) | (kl != 0)
 
     frontier_rows, frontier_ebits = _live_frontier(checker)
@@ -101,9 +105,9 @@ def _live_frontier(checker):
 
     if isinstance(checker, ShardedXlaChecker):
         D, Fl, W = checker._D, checker._Fl, checker._W
-        rows = np.asarray(checker._frontier).reshape(D, Fl, W)
-        ebits = np.asarray(checker._frontier_ebits).reshape(D, Fl)
-        counts = np.asarray(checker._counts)
+        rows = checker._host_read(checker._frontier).reshape(D, Fl, W)
+        ebits = checker._host_read(checker._frontier_ebits).reshape(D, Fl)
+        counts = checker._host_read(checker._counts)
         live_rows = [rows[d, : counts[d]] for d in range(D)]
         live_ebits = [ebits[d, : counts[d]] for d in range(D)]
         return (

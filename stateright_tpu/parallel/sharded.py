@@ -284,8 +284,9 @@ class ShardedXlaChecker(Checker):
 
     _dedup_words_host = XlaChecker._dedup_words_host
     _packed_fp64 = XlaChecker._packed_fp64
-    _parent_map = XlaChecker._parent_map
     _path_for = XlaChecker._path_for
+    # _parent_map is overridden below: it must gather table planes across
+    # processes before indexing them.
 
     # --- device programs ---------------------------------------------------
 
@@ -763,6 +764,49 @@ class ShardedXlaChecker(Checker):
             self._step_cache[key] = fn
         return fn
 
+    # --- host materialization ----------------------------------------------
+
+    def _host_read(self, arr) -> np.ndarray:
+        """Materialize a (possibly cross-process) sharded device array on
+        every host. Single-process: a plain transfer. Multi-process (the
+        ``jax.distributed`` DCN path): an allgather of addressable shards —
+        ``np.asarray`` alone raises on arrays spanning non-addressable
+        devices."""
+        import jax
+
+        if jax.process_count() == 1:
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    def _counts_total(self) -> int:
+        """Global frontier size: device-side psum, replicated output, so no
+        host ever touches the sharded counts plane directly."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self.__dict__.get("_counts_total_fn")
+        if fn is None:
+            fn = jax.jit(
+                lambda c: jnp.sum(c, dtype=jnp.int32),
+                out_shardings=self._rep_sharding,
+            )
+            self.__dict__["_counts_total_fn"] = fn
+        return int(np.asarray(fn(self._counts)))
+
+    def _parent_map(self):
+        """The single-chip walk over a gathered copy of the table planes
+        (multi-process safe via ``_host_read``)."""
+        from ..native import ParentMap
+
+        return ParentMap(
+            self._host_read(self._table.key_hi),
+            self._host_read(self._table.key_lo),
+            self._host_read(self._table.val_hi),
+            self._host_read(self._table.val_lo),
+        )
+
     # --- growth -----------------------------------------------------------
 
     def _grow_table(self) -> None:
@@ -793,7 +837,7 @@ class ShardedXlaChecker(Checker):
             out_specs=((P("shards"),) * 4, P("shards")),
         )
         planes, ovf = fn(tuple(old))
-        if bool(np.any(np.asarray(ovf))):  # pragma: no cover
+        if bool(np.any(self._host_read(ovf))):  # pragma: no cover
             raise RuntimeError("rehash overflow — pathological fingerprint distribution")
         self._table = hashset.HashSet(*planes)
         self._Cl = new_Cl
@@ -852,8 +896,7 @@ class ShardedXlaChecker(Checker):
             return False
         if self._P > 0 and all(n in self._found_names for n in self._prop_names):
             return False
-        total = int(np.sum(np.asarray(self._counts)))
-        if total == 0:
+        if self._counts_total() == 0:
             self._exhausted = True
             return False
         self._max_depth = max(self._max_depth, self._depth)
@@ -961,7 +1004,7 @@ class ShardedXlaChecker(Checker):
                 continue
             if committed == 0:
                 break
-            if int(np.sum(np.asarray(self._counts))) == 0:
+            if self._counts_total() == 0:
                 break
             if self._P > 0 and all(
                 n in self._found_names for n in self._prop_names
@@ -1018,8 +1061,8 @@ class ShardedXlaChecker(Checker):
     def _visit_frontier(self) -> None:
         """Same visitor truncation contract as the single-chip engine: at
         most ``spawn_xla(visit_cap=...)`` states per level, loud warning."""
-        rows = np.asarray(self._frontier).reshape(self._D, self._Fl, self._W)
-        counts = np.asarray(self._counts)
+        rows = self._host_read(self._frontier).reshape(self._D, self._Fl, self._W)
+        counts = self._host_read(self._counts)
         total = int(counts.sum())
         if total > self._visit_cap:
             import warnings
@@ -1063,7 +1106,7 @@ class ShardedXlaChecker(Checker):
             return True
         if self._P > 0 and all(n in self._found_names for n in self._prop_names):
             return True
-        return int(np.sum(np.asarray(self._counts))) == 0 and self._state_count > 0
+        return self._counts_total() == 0 and self._state_count > 0
 
     def discoveries(self):
         parents = self._parent_map()

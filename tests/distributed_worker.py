@@ -1,0 +1,73 @@
+"""Worker body for the two-process distributed-mesh test.
+
+Each process contributes 4 virtual CPU devices to an 8-device global mesh
+(`jax.distributed` over localhost — the DCN path of SURVEY §2.8), runs the
+SAME sharded check SPMD-style, and prints one RESULT line. The reference's
+checker is shared-memory only (bfs.rs:89-93); this is the scale-out path it
+doesn't have.
+
+Usage: distributed_worker.py <process_id> <num_processes> <coordinator_port>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 4 * nproc
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    mesh = Mesh(np.asarray(jax.devices()), ("shards",))
+    checker = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(mesh=mesh, frontier_capacity=1 << 9, table_capacity=1 << 12)
+        .join()
+    )
+    # discoveries() gathers table planes across processes (a collective:
+    # every process must reach it, SPMD-style) and rebuilds witness paths.
+    paths = ";".join(
+        f"{name}:{len(path)}" for name, path in sorted(checker.discoveries().items())
+    )
+    # Checkpointing allgathers the same planes; every process saves (the
+    # allgather is a collective) to its own path, and the payload must
+    # describe the GLOBAL search state on each.
+    import tempfile
+
+    from stateright_tpu.checkpoint import load_checkpoint
+
+    ckpt = os.path.join(tempfile.gettempdir(), f"dw_ckpt_{os.getpid()}.npz")
+    checker.save_checkpoint(ckpt)
+    ck = load_checkpoint(ckpt)
+    os.unlink(ckpt)
+    assert ck["meta"]["unique_count"] == checker.unique_state_count()
+    assert len(ck["key_hi"]) == checker.unique_state_count()
+    print(
+        f"RESULT pid={pid} states={checker.state_count()} "
+        f"unique={checker.unique_state_count()} depth={checker.max_depth()} "
+        f"paths={paths}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""The sharded engine across a REAL process boundary.
+
+Two OS processes x 4 virtual CPU devices form one 8-device global mesh via
+``jax.distributed`` (collectives ride the gloo/gRPC transport — the DCN
+path of SURVEY §2.8). Both run the same sharded 2pc(3) check SPMD-style;
+exact-count parity with the host oracle proves the engine's collectives
+(`all_to_all` exchange, psum/pmax reductions, allgather-backed witness
+reconstruction) survive a process boundary. The reference checker is
+shared-memory only (``/root/reference/src/checker/bfs.rs:89-93``); this is
+the scale-out axis it does not have.
+
+The in-suite sharded tests (test_sharded.py) cover the same engine on a
+single-process 8-device mesh; this file covers ONLY what the process
+boundary changes: non-addressable shards, cross-process collectives, and
+host materialization (``_host_read`` / ``_counts_total``).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_exact_parity():
+    port = _free_port()
+    env = dict(os.environ)
+    # The workers pick their own backend/device-count; the conftest's
+    # 8-device XLA_FLAGS would fight the workers' 4-per-process split.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(rank), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+            env=env,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=720)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = []
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        lines = [l for l in out.splitlines() if l.startswith("RESULT")]
+        assert p.returncode == 0 and lines, (
+            f"worker {rank} rc={p.returncode}; output tail:\n" + out[-2000:]
+        )
+        results.append(lines[0].split(" ", 2)[2])  # strip "RESULT pid=k"
+
+    # Both processes observe the same global result...
+    assert results[0] == results[1]
+    # ...and it is the host oracle's exact count profile for 2pc(3)
+    # (BASELINE.md: 288 unique / 1,146 generated incl. init), with both
+    # SOMETIMES witnesses reconstructed at BFS-minimal depth.
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    oracle = TwoPhaseSys(3).checker().spawn_bfs().join()
+    expected_paths = ";".join(
+        f"{name}:{len(path)}" for name, path in sorted(oracle.discoveries().items())
+    )
+    assert results[0] == (
+        f"states={oracle.state_count()} unique={oracle.unique_state_count()} "
+        f"depth={oracle.max_depth()} paths={expected_paths}"
+    )
