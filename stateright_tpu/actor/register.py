@@ -272,95 +272,54 @@ class PackedClientsMixin:
         dup = L.get(w, "net", idx) != 0
         return L.set(w, "net", 1, idx), dup
 
-    def device_linearizable_register(self, words):
+    def device_linearizable_register(self, words, pattern_limit=None):
         """EXACT linearizability of the packed history, entirely on device —
         no host fallback (SURVEY §7 M4 variant (b), upgrading the
         conservative-predicate + host-serializer design of variant (a)).
 
-        Replicates the backtracking serializer's semantics
-        (linearizability.rs:197-284 / semantics/_backtracking.py) for the
-        bounded shape these clients produce — 2 threads, at most 2 completed
-        ops plus one in-flight op each, over the ``Register`` spec — by
-        statically enumerating every interleaving:
+        Delegates to the generalized static-enumeration serializer
+        (:func:`stateright_tpu.semantics.device.device_serializable`):
+        works for any thread count / op bound whose interleaving count
+        stays under ``semantics.device.MAX_PATTERNS``; larger shapes pass
+        ``pattern_limit`` (a one-sided sampled pass) and declare the
+        property in ``host_verified_properties``.
 
-        - each thread's schedule is its completed ops in order, then its
-          in-flight op (slot ``n_t``), then padding, always 3 slots;
-        - all C(6,3) = 20 merges of two 3-slot sequences are simulated in
-          one fused expression, OR-ed;
-        - *excluding* an in-flight op (the tester's choice, "in-flight ops
-          need never return") is subsumed by scheduling it LAST: a trailing
-          register write changes no earlier read, invoke() always succeeds,
-          and the real-time prerequisite check is vacuous once every peer
-          op is scheduled — and merges with each thread's slot-2 last exist
-          in the enumeration;
-        - per step, the real-time constraint checks the op's recorded
-          prerequisite index against how many peer completed ops are
-          scheduled so far (static per pattern position), and the register
-          semantics check ReadOk values against the running register value.
-
-        Returns a bool vector usable directly as an ``always`` property —
+        Returns a bool usable directly as an ``always`` property —
         differentially tested against ``serialized_history()`` over every
         reachable history of the register models.
         """
-        import itertools
+        from ..semantics.device import DeviceRegister, device_serializable
 
-        import jax.numpy as jnp
-
-        h = self._hist
-        if len(h.thread_ids) != 2 or h.max_ops != 2:
-            raise NotImplementedError(
-                "exact device linearizability is implemented for the "
-                "2-thread / 2-op register-client shape"
+        if not self._hist.real_time:
+            raise ValueError(
+                "device_linearizable_register needs a BoundedHistory with "
+                "real_time=True: a prereq-free history would silently "
+                "degrade the check to sequential consistency"
             )
-        L, u32 = self._layout, jnp.uint32
-        n = [L.get(words, f"h{t}_n") for t in range(2)]
-        fl = [L.get(words, f"h{t}_fl") for t in range(2)]
-        flpre = [L.get(words, f"h{t}_flpre", 0) for t in range(2)]
-        op = [[L.get(words, f"h{t}_op", j) for j in range(2)] for t in range(2)]
-        ret = [[L.get(words, f"h{t}_ret", j) for j in range(2)] for t in range(2)]
-        pre = [[L.get(words, f"h{t}_pre", j) for j in range(2)] for t in range(2)]
+        return device_serializable(
+            self._hist,
+            words,
+            DeviceRegister(),
+            real_time=True,
+            pattern_limit=pattern_limit,
+        )
 
-        false = jnp.bool_(False)
-        any_ok = false
-        for pos0 in itertools.combinations(range(6), 3):
-            seq = [1] * 6
-            for i in pos0:
-                seq[i] = 0
-            v = u32(0)  # running register value code (0 = unwritten None)
-            ok = jnp.bool_(True)
-            cnt = [0, 0]
-            for t in seq:
-                s = cnt[t]
-                cnt[t] += 1
-                peer = 1 - t
-                # Slot roles (all stored codes are +1; prereqs +2; 0 = none).
-                is_comp = u32(s) < n[t]
-                is_fl = (u32(s) == n[t]) & (fl[t] != 0)
-                o_comp = op[t][s] if s < 2 else u32(0)
-                r_comp = ret[t][s] if s < 2 else u32(0)
-                b_comp = pre[t][s] if s < 2 else u32(0)
-                o = jnp.where(is_comp, o_comp, jnp.where(is_fl, fl[t], u32(0)))
-                b = jnp.where(is_comp, b_comp, jnp.where(is_fl, flpre[t], u32(0)))
-                active = is_comp | is_fl
-                # Real-time: peer completed ops with index <= (b - 2) must
-                # already be scheduled (linearizability.rs:221-233). cnt is
-                # the static count of peer slots visited so far.
-                sched_peer = jnp.minimum(u32(cnt[peer]), n[peer])
-                rt_ok = (b == 0) | (b - u32(2) < sched_peer)
-                # Register semantics (semantics/register.rs:26-49): a
-                # completed Read must return the running value; a completed
-                # Write returns WriteOk. In-flight effects are free.
-                is_read = o == u32(1)
-                is_write = o >= u32(2)
-                sem_ok = jnp.where(
-                    is_comp,
-                    jnp.where(is_read, r_comp == v + u32(2), r_comp == u32(1)),
-                    jnp.bool_(True),
-                )
-                ok = ok & (~active | (rt_ok & sem_ok))
-                v = jnp.where(active & is_write, o - u32(2), v)
-            any_ok = any_ok | ok
-        return (L.get(words, "h_valid") != 0) & any_ok
+    def device_sequentially_consistent_register(self, words, pattern_limit=None):
+        """EXACT sequential consistency of the packed history on device:
+        the same enumeration as :meth:`device_linearizable_register` with
+        the real-time constraint dropped (the device counterpart of
+        ``SequentialConsistencyTester``, sequential_consistency.rs:53-241).
+        Correct for histories packed with either ``real_time`` setting
+        (prereq snapshots are simply ignored)."""
+        from ..semantics.device import DeviceRegister, device_serializable
+
+        return device_serializable(
+            self._hist,
+            words,
+            DeviceRegister(),
+            real_time=False,
+            pattern_limit=pattern_limit,
+        )
 
     # --- vectorized delivery bodies ----------------------------------------
     # Each takes (words[W], e, prm[cols]) with traced envelope code and

@@ -26,6 +26,42 @@ PutFail = variant("PutFail", ["request_id"])
 GetOk = variant("GetOk", ["request_id", "value"])
 
 
+def wo_history_codecs(values):
+    """Closed-universe op/ret codes for WORegister histories over ``values``
+    (``values[0]`` is the unwritten ``None``) — the WORegister analogue of
+    ``register.history_codecs``, for packed models running
+    :class:`~stateright_tpu.packing.BoundedHistory` over a
+    ``LinearizabilityTester(WORegister(None))`` with the device check
+    :class:`~stateright_tpu.semantics.device.DeviceWORegister`.
+
+    Returns ``(op_code, code_op, ret_code, code_ret)``:
+    ``Read() = 0``, ``Write(v) = 1 + values.index(v)``;
+    ``WriteOk() = 0``, ``WriteFail() = 1``, ``ReadOk(v) = 2 + values.index(v)``.
+    """
+
+    def op_code(op):
+        return 0 if isinstance(op, WORead) else 1 + values.index(op.value)
+
+    def code_op(c):
+        return WORead() if c == 0 else WOWrite(values[c - 1])
+
+    def ret_code(ret):
+        if isinstance(ret, WOWriteOk):
+            return 0
+        if isinstance(ret, WOWriteFail):
+            return 1
+        return 2 + values.index(ret.value)
+
+    def code_ret(c):
+        if c == 0:
+            return WOWriteOk()
+        if c == 1:
+            return WOWriteFail()
+        return WOReadOk(values[c - 2])
+
+    return op_code, code_op, ret_code, code_ret
+
+
 def record_invocations(cfg, history, env):
     """Pass to ``ActorModel.record_msg_out`` (write_once_register.rs:39-61)."""
     if isinstance(env.msg, Get):

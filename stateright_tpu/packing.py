@@ -501,7 +501,14 @@ class BoundedHistory:
         max_ops: int,
         op_bits: int,
         ret_bits: int,
+        real_time: bool = True,
     ):
+        #: Whether invocations snapshot real-time prerequisites. True for
+        #: LinearizabilityTester histories; False for
+        #: SequentialConsistencyTester ones (sequential_consistency.rs
+        #: records none) — the prereq fields then stay 0, so packed states
+        #: collapse exactly like the host tester's equality does.
+        self.real_time = real_time
         self.thread_ids = list(thread_ids)
         self.max_ops = max_ops
         self.op_bits = op_bits
@@ -557,12 +564,13 @@ class BoundedHistory:
         do = enabled & ~misuse
         new = jnp.where(do, _u32(op_code) + jnp.uint32(1), cur)
         words = L.set(words, f"h{t}_fl", new)
-        for pi, p in enumerate(self.peers[t]):
-            pn = L.get(words, f"h{p}_n")
-            # Tester semantics: peers with no completed ops are absent.
-            pre = jnp.where(pn > 0, pn + jnp.uint32(1), jnp.uint32(0))  # (n-1)+2
-            cur = L.get(words, f"h{t}_flpre", pi)
-            words = L.set(words, f"h{t}_flpre", jnp.where(do, pre, cur), pi)
+        if self.real_time:
+            for pi, p in enumerate(self.peers[t]):
+                pn = L.get(words, f"h{p}_n")
+                # Tester semantics: peers with no completed ops are absent.
+                pre = jnp.where(pn > 0, pn + jnp.uint32(1), jnp.uint32(0))  # (n-1)+2
+                cur = L.get(words, f"h{t}_flpre", pi)
+                words = L.set(words, f"h{t}_flpre", jnp.where(do, pre, cur), pi)
         return words
 
     def on_return(self, words, t: int, ret_code, enabled=True):

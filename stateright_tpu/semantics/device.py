@@ -1,0 +1,265 @@
+"""Exact consistency checking ON DEVICE, generalized over thread count,
+operation count, and sequential spec.
+
+The host testers (``linearizability.py`` / ``sequential_consistency.py``)
+run a backtracking search per history
+(``/root/reference/src/semantics/linearizability.rs:197-284``,
+``/root/reference/src/semantics/sequential_consistency.rs:53-241``). A
+backtracking search cannot be traced into an XLA program — but for the
+statically-bounded histories packed models carry
+(:class:`~stateright_tpu.packing.BoundedHistory`: T threads, at most M
+completed ops plus one in-flight op each), the whole search space is a
+*static enumeration*: every admissible serialization is a merge of the
+per-thread sequences, i.e. an arrangement of the multiset
+``{0^(M+1), ..., (T-1)^(M+1)}``. This module evaluates ALL of them as one
+data-parallel expression — patterns become a constant ``[P, L]`` index
+table, and each BFS frontier row checks every pattern simultaneously. That
+is the TPU-first shape of the problem: no control flow, one fused
+gather/where pipeline, ``P`` as a vector lane axis.
+
+Semantics replicated (differentially tested against the host serializer):
+
+- per-thread program order is preserved by construction (a thread's slots
+  appear in sequence order in every pattern);
+- **linearizability** additionally checks the recorded real-time
+  prerequisites: an op invoked after a peer's op completed must be
+  serialized after it (linearizability.rs:221-233);
+- **sequential consistency** is the same enumeration with the real-time
+  constraint dropped (sequential_consistency.rs:118-130 tracks only
+  in-order per-thread consumption);
+- in-flight ops "need never return" (the testers may exclude them): an
+  excluded in-flight op is subsumed by a pattern scheduling it after every
+  constrained op, because specs here are *total* (every op is invocable in
+  every spec state) and a trailing op constrains nothing — completed-op
+  prerequisites reference peer *completed* ops only;
+- a poisoned history (``h_valid`` cleared by protocol misuse) is never
+  serializable, matching the testers' HistoryError freeze.
+
+Sizing: P = (T·(M+1))! / ((M+1)!)^T — 20 at 2×2, 1 680 at 3×2, 34 650 at
+3×3. Past ``MAX_PATTERNS`` the enumeration no longer earns its keep on
+device; models should fall back to the engine's
+``host_verified_properties`` path (a conservative device predicate +
+exact host confirmation, xla.py M4 variant (a)).
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+
+#: Past this many interleavings, refuse and point at host_verified_properties
+#: (4 threads x 2 ops = 369 600 patterns ~ 20x the 3x3 cost per state).
+MAX_PATTERNS = 50_000
+
+
+@lru_cache(maxsize=None)
+def interleaving_tables(
+    T: int, slots: int, limit: int = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Static pattern tables for merges of T sequences of ``slots`` slots.
+
+    Returns ``(tid[P, L], slot[P, L], cnt_before[P, L, T])`` where L =
+    T*slots: the thread scheduled at each step, its per-thread slot index,
+    and how many slots of every thread precede the step. With ``limit``
+    (< the full count), a deterministic uniform random sample of ``limit``
+    arrangements is generated directly — the full table is never built.
+    """
+    L = T * slots
+    P_full = pattern_count(T, slots - 1)
+    if limit is not None and limit < P_full:
+        # A one-sided (host-confirmed) pass wants pattern DIVERSITY, and it
+        # must NOT materialize the full enumeration (1.7e8 patterns at 5
+        # threads x 2 ops): sample arrangements directly — each row is an
+        # independent uniform shuffle of the multiset {t^slots}, which is
+        # uniform over distinct patterns (duplicates merely waste lanes;
+        # negligible while limit << P). Deterministic seed for stable
+        # compilation caching.
+        rng = np.random.default_rng(0xC0FFEE)
+        base = np.repeat(np.arange(T, dtype=np.int32), slots)
+        tid = np.asarray(rng.permuted(np.tile(base, (limit, 1)), axis=1))
+    else:
+        pats: list = []
+
+        def rec(remaining: tuple, t: int, cur: list) -> None:
+            if t == T - 1:
+                pat = list(cur)
+                for pos in remaining:
+                    pat[pos] = t
+                pats.append(pat)
+                return
+            for comb in itertools.combinations(remaining, slots):
+                taken = set(comb)
+                nxt = list(cur)
+                for pos in comb:
+                    nxt[pos] = t
+                rec(tuple(p for p in remaining if p not in taken), t + 1, nxt)
+
+        rec(tuple(range(L)), 0, [0] * L)
+        tid = np.asarray(pats, dtype=np.int32)
+    P = tid.shape[0]
+    slot = np.zeros((P, L), dtype=np.int32)
+    cnt_before = np.zeros((P, L, T), dtype=np.int32)
+    running = np.zeros((P, T), dtype=np.int32)
+    rows = np.arange(P)
+    for l in range(L):
+        cnt_before[:, l, :] = running
+        slot[:, l] = running[rows, tid[:, l]]
+        running[rows, tid[:, l]] += 1
+    return tid, slot, cnt_before
+
+
+def pattern_count(T: int, max_ops: int) -> int:
+    """P without building the tables: (T*(M+1))! / ((M+1)!)^T."""
+    import math
+
+    slots = max_ops + 1
+    return math.factorial(T * slots) // math.factorial(slots) ** T
+
+
+class DeviceRegister:
+    """Device form of :class:`~stateright_tpu.semantics.register.Register`
+    under the ``history_codecs`` convention (register.py:102-128): stored
+    op codes — ``Read = 1``, ``Write(values[i]) = 2 + i``; stored ret
+    codes — ``WriteOk = 1``, ``ReadOk(values[i]) = 2 + i``; running value
+    is the ``values`` index (0 = unwritten None)."""
+
+    def init_value(self, jnp, shape):
+        return jnp.zeros(shape, jnp.uint32)
+
+    def step(self, jnp, v, o, r, is_comp):
+        is_read = o == jnp.uint32(1)
+        is_write = o >= jnp.uint32(2)
+        sem_ok = jnp.where(
+            is_comp,
+            jnp.where(is_read, r == v + jnp.uint32(2), r == jnp.uint32(1)),
+            True,
+        )
+        v = jnp.where(is_write, o - jnp.uint32(2), v)
+        return sem_ok, v
+
+
+class DeviceWORegister:
+    """Device form of
+    :class:`~stateright_tpu.semantics.write_once_register.WORegister`
+    (write_once_register.rs:9-58: first write wins, rewrites of the same
+    value succeed, different-value writes fail) under ``wo_history_codecs``:
+    stored op codes — ``Read = 1``, ``Write(values[i]) = 2 + i``; stored
+    ret codes — ``WriteOk = 1``, ``WriteFail = 2``,
+    ``ReadOk(values[i]) = 3 + i``."""
+
+    def init_value(self, jnp, shape):
+        return jnp.zeros(shape, jnp.uint32)
+
+    def step(self, jnp, v, o, r, is_comp):
+        u32 = jnp.uint32
+        is_read = o == u32(1)
+        is_write = o >= u32(2)
+        w_val = o - u32(2)
+        accepts = (v == u32(0)) | (v == w_val)  # unwritten or same value
+        write_ok = jnp.where(accepts, r == u32(1), r == u32(2))
+        sem_ok = jnp.where(
+            is_comp,
+            jnp.where(is_read, r == v + u32(3), write_ok),
+            True,
+        )
+        v = jnp.where(is_write & accepts, w_val, v)
+        return sem_ok, v
+
+
+def device_serializable(hist, words, spec, *, real_time: bool, pattern_limit=None):
+    """True iff the packed history in ``words`` admits a legal serialization
+    of ``spec`` — the traced, exact device form of
+    ``BacktrackingTester.serialized_history() is not None``
+    (real_time=True: linearizability; False: sequential consistency).
+
+    ``hist`` is the model's bound :class:`BoundedHistory`; jnp-traceable per
+    state row (vmap over the frontier).
+
+    ``pattern_limit``: evaluate only the first N patterns. The result is
+    then one-sided — True still proves serializability, False means
+    *unknown* — which is exactly the conservative-predicate contract of the
+    engine's ``host_verified_properties`` path: use a limited device pass to
+    clear the bulk of the frontier and let the host serializer confirm the
+    flagged remainder.
+    """
+    import jax.numpy as jnp
+
+    T = len(hist.thread_ids)
+    M = hist.max_ops
+    slots = M + 1
+    P = pattern_count(T, M)
+    if pattern_limit is None and P > MAX_PATTERNS:
+        raise NotImplementedError(
+            f"{P} interleavings ({T} threads x {M}+1 ops) exceeds "
+            f"MAX_PATTERNS={MAX_PATTERNS}; declare the property in "
+            "host_verified_properties instead (conservative device "
+            "predicate — this function with pattern_limit= — plus exact "
+            "host confirmation)."
+        )
+    L_ = hist.layout
+    u32 = jnp.uint32
+    tid, slot, cnt_before = interleaving_tables(
+        T, slots, None if pattern_limit is None or pattern_limit >= P else pattern_limit
+    )
+    P = tid.shape[0]
+    Lsteps = T * slots
+
+    N = jnp.stack([L_.get(words, f"h{t}_n") for t in range(T)])  # [T]
+    FL = jnp.stack([L_.get(words, f"h{t}_fl") for t in range(T)])  # [T]
+    # Completed-op tables, padded to `slots` so the static slot index is
+    # always in bounds (the pad row is only gathered when inactive).
+    zero = jnp.uint32(0)
+    OP = jnp.stack(
+        [
+            jnp.stack([L_.get(words, f"h{t}_op", j) for j in range(M)] + [zero])
+            for t in range(T)
+        ]
+    )  # [T, slots]
+    RET = jnp.stack(
+        [
+            jnp.stack([L_.get(words, f"h{t}_ret", j) for j in range(M)] + [zero])
+            for t in range(T)
+        ]
+    )  # [T, slots]
+    npeer = max(T - 1, 1)
+    # Prereqs on absolute thread columns (self column stays 0 = no entry).
+    PRE = jnp.zeros((T, slots, T), u32)
+    FLPRE = jnp.zeros((T, T), u32)
+    for t in range(T):
+        for pi, q in enumerate(hist.peers[t]):
+            FLPRE = FLPRE.at[t, q].set(L_.get(words, f"h{t}_flpre", pi))
+            for j in range(M):
+                PRE = PRE.at[t, j, q].set(L_.get(words, f"h{t}_pre", j * npeer + pi))
+
+    v = spec.init_value(jnp, (P,))
+    ok = jnp.ones((P,), bool)
+    for l in range(Lsteps):
+        tl, sl = tid[:, l], slot[:, l]  # static index vectors [P]
+        n_t = N[tl]
+        is_comp = u32(sl) < n_t
+        is_fl = (u32(sl) == n_t) & (FL[tl] != 0)
+        active = is_comp | is_fl
+        o = jnp.where(is_comp, OP[tl, sl], jnp.where(is_fl, FL[tl], zero))
+        r = jnp.where(is_comp, RET[tl, sl], zero)
+        if real_time:
+            rt = jnp.ones((P,), bool)
+            for q in range(T):
+                b = jnp.where(
+                    is_comp, PRE[tl, sl, q], jnp.where(is_fl, FLPRE[tl, q], zero)
+                )
+                # Peer q's completed ops scheduled so far: its slots seen so
+                # far (static), capped at its completed count (dynamic).
+                sched = jnp.minimum(u32(cnt_before[:, l, q]), N[q])
+                # b stores prereq index + 2; 0 = no entry. b >= 2 whenever
+                # nonzero, so b - 2 cannot wrap on the checked branch.
+                rt = rt & ((b == zero) | (b - u32(2) < sched))
+        else:
+            rt = True
+        sem_ok, nv = spec.step(jnp, v, o, r, is_comp)
+        # Inactive (padding) steps constrain nothing and change nothing.
+        ok = ok & (~active | (rt & sem_ok))
+        v = jnp.where(active, nv, v)
+    return (L_.get(words, "h_valid") != 0) & jnp.any(ok)

@@ -44,11 +44,12 @@ def _reachable(model, cap=20000):
     [
         lambda: PackedSingleCopyRegister(2, 1),
         lambda: PackedSingleCopyRegister(2, 2),  # the non-linearizable config
+        pytest.param(lambda: PackedSingleCopyRegister(3, 1), marks=pytest.mark.slow),
         lambda: PackedAbd(2, 2),
         lambda: PackedSingleCopyRegisterOrdered(2),
         pytest.param(lambda: PackedPaxos(2, 3), marks=pytest.mark.slow),
     ],
-    ids=["single-copy-1s", "single-copy-2s", "abd", "ordered", "paxos"],
+    ids=["single-copy-1s", "single-copy-2s", "single-copy-3c", "abd", "ordered", "paxos"],
 )
 def test_device_predicate_matches_serializer_on_every_reachable_state(make):
     import jax
