@@ -197,9 +197,12 @@ def linearizable_register_model(
 
 
 class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
-    """The ABD quorum register on the device engine (``spawn_xla``), for the
-    oracle configuration: 2 clients / 2 servers, 544 unique states
-    (linearizable-register.rs:289,316).
+    """The ABD quorum register on the device engine (``spawn_xla``): the
+    oracle configuration (2 clients / 2 servers, 544 unique states,
+    linearizable-register.rs:289,316) and the 3-client / 2-server
+    configuration, whose ``linearizable`` property runs device-EXACT over
+    the 3-thread interleaving enumeration
+    (:mod:`stateright_tpu.semantics.device`).
 
     Same construction as :class:`~stateright_tpu.models.paxos.PackedPaxos`:
     a syntactically closed envelope universe as presence bits (empirically
@@ -216,18 +219,24 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
     ``seq_code * NV + val_code``. The 2-server restriction keeps quorum
     arithmetic static (majority = 2: the coordinator's self-entry plus the
     single peer); wider clusters model-check on the host engines.
+
+    Requests are keyed ``(coordinator s, local index r)``: server ``s``
+    coordinates client k's Put when ``(S+k) % S == s`` and client k's Get
+    when ``(S+k+1) % S == s`` (the RegisterClient round-robin,
+    register.rs:118-120) — ``self._reqs[s]`` lists ``(client, kind)`` with
+    kind 0 = Put, 1 = Get.
     """
 
     def __init__(self, client_count: int = 2, server_count: int = 2):
         from ..actor.network import Envelope
         from ..packing import BoundedHistory, LayoutBuilder, OverflowError32, bits_for
 
-        if (client_count, server_count) != (2, 2):
+        if server_count != 2 or client_count not in (2, 3):
             raise ValueError(
-                "PackedAbd packs the 2-client/2-server oracle configuration; "
-                "other sizes run on the host engines"
+                "PackedAbd packs S=2 (single-peer quorum arithmetic) with "
+                "2 or 3 clients; other sizes run on the host engines"
             )
-        C = S = 2
+        C, S = client_count, server_count
         self.C, self.S = C, S
         self.majority = S // 2 + 1
         self._inner = linearizable_register_model(C, S)
@@ -244,16 +253,29 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         self.NSQ = NSQ
         NSV = NSQ * NV  # (seq, value) pair codes
 
-        # Per-server request universe: server s coordinates the Put of
-        # client s (request id S+s) and the Get of client (s+1)%S
-        # (request id 2*(S+(s+1)%S)); req_bit 0 = that Put, 1 = that Get.
-        def req_id(s: int, req_bit: int) -> int:
-            return (S + s) if req_bit == 0 else 2 * (S + (s + 1) % S)
+        # Per-server request table (see class docstring): Puts first, then
+        # Gets, so the 2-client table reproduces the round-1 (Put, Get)
+        # req_bit order exactly.
+        reqs = {s: [] for s in range(S)}
+        for k in range(C):
+            reqs[(S + k) % S].append((k, 0))
+        for k in range(C):
+            reqs[(S + k + 1) % S].append((k, 1))
+        self._reqs = reqs
+        self._maxR = max(len(v) for v in reqs.values())
 
-        def requester(s: int, req_bit: int) -> int:
-            return (S + s) if req_bit == 0 else (S + (s + 1) % S)
+        def req_id(s: int, r: int) -> int:
+            k, kind = reqs[s][r]
+            return (S + k) if kind == 0 else 2 * (S + k)
+
+        def requester(s: int, r: int) -> int:
+            return S + reqs[s][r][0]
 
         self._req_id, self._requester = req_id, requester
+        rix = {}  # (client, kind) -> (coordinator, local request index)
+        for s in range(S):
+            for r, (k, kind) in enumerate(reqs[s]):
+                rix[(k, kind)] = (s, r)
 
         # --- the closed envelope universe -------------------------------
         envs: list = []
@@ -271,7 +293,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
             i = S + k
             self._code_put.append(len(envs))
             envs.append(Envelope(Id(i), Id(i % S), reg.Put(i, self.values[1 + k])))
-            handlers.append(("begin", (i % S, 0)))
+            handlers.append(("begin", rix[(k, 0)]))
         for k in range(C):
             self._code_putok.append(len(envs))
             envs.append(Envelope(Id(k % S), Id(S + k), reg.PutOk(S + k)))
@@ -280,7 +302,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
             i = S + k
             self._code_get.append(len(envs))
             envs.append(Envelope(Id(i), Id((i + 1) % S), reg.Get(2 * i)))
-            handlers.append(("begin", ((i + 1) % S, 1)))
+            handlers.append(("begin", rix[(k, 1)]))
         for k in range(C):
             i = S + k
             self._base_getok.append(len(envs))
@@ -291,14 +313,14 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                 handlers.append(("getok", (k, v)))
         for c in range(S):  # Query: coordinator c -> its peer
             p = (c + 1) % S
-            for rb in range(2):
-                self._code_query[(c, rb)] = len(envs)
-                envs.append(Envelope(Id(c), Id(p), reg.Internal(Query(req_id(c, rb)))))
-                handlers.append(("query", (p, c, rb)))
+            for r in range(len(reqs[c])):
+                self._code_query[(c, r)] = len(envs)
+                envs.append(Envelope(Id(c), Id(p), reg.Internal(Query(req_id(c, r)))))
+                handlers.append(("query", (p, c, r)))
         for c in range(S):  # AckQuery: peer -> coordinator, contiguous in (seq, val)
             p = (c + 1) % S
-            for rb in range(2):
-                self._base_ackquery[(c, rb)] = len(envs)
+            for r in range(len(reqs[c])):
+                self._base_ackquery[(c, r)] = len(envs)
                 for sq in range(NSQ):
                     for v in range(NV):
                         envs.append(
@@ -307,16 +329,16 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                                 Id(c),
                                 reg.Internal(
                                     AckQuery(
-                                        req_id(c, rb), self._seqs[sq], self.values[v]
+                                        req_id(c, r), self._seqs[sq], self.values[v]
                                     )
                                 ),
                             )
                         )
-                        handlers.append(("ackquery", (c, rb, p, sq * NV + v)))
+                        handlers.append(("ackquery", (c, r, p, sq * NV + v)))
         for c in range(S):  # Record: coordinator -> peer, contiguous in (seq, val)
             p = (c + 1) % S
-            for rb in range(2):
-                self._base_record[(c, rb)] = len(envs)
+            for r in range(len(reqs[c])):
+                self._base_record[(c, r)] = len(envs)
                 for sq in range(NSQ):
                     for v in range(NV):
                         envs.append(
@@ -325,20 +347,20 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                                 Id(p),
                                 reg.Internal(
                                     Record(
-                                        req_id(c, rb), self._seqs[sq], self.values[v]
+                                        req_id(c, r), self._seqs[sq], self.values[v]
                                     )
                                 ),
                             )
                         )
-                        handlers.append(("record", (p, c, rb, sq * NV + v)))
+                        handlers.append(("record", (p, c, r, sq * NV + v)))
         for c in range(S):  # AckRecord: peer -> coordinator
             p = (c + 1) % S
-            for rb in range(2):
-                self._code_ackrecord[(c, rb)] = len(envs)
+            for r in range(len(reqs[c])):
+                self._code_ackrecord[(c, r)] = len(envs)
                 envs.append(
-                    Envelope(Id(p), Id(c), reg.Internal(AckRecord(req_id(c, rb))))
+                    Envelope(Id(p), Id(c), reg.Internal(AckRecord(req_id(c, r))))
                 )
-                handlers.append(("ackrecord", (c, rb, p)))
+                handlers.append(("ackrecord", (c, r, p)))
 
         self._envs = envs
         self._handlers = handlers
@@ -351,8 +373,10 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         b.array("seq", S, bits_for(NSQ - 1))
         b.array("val", S, bits_for(NV - 1))
         b.array("kind", S, 2)  # 0 = no phase, 1 = Phase1, 2 = Phase2
-        b.array("p_req", S, 1)  # req_bit of the active phase
-        b.array("read", S, 2)  # Phase2: 0 = write op, 1+v = read of values[v]
+        # Local request index of the active phase (see self._reqs).
+        b.array("p_req", S, max(bits_for(self._maxR - 1), 1))
+        # Phase2: 0 = write op, 1+v = read of values[v].
+        b.array("read", S, bits_for(NV))
         b.array("rp", S * S, 1)  # Phase1 responses presence, idx s*S + key
         b.array("rv", S * S, bits_for(NSV - 1))  # Phase1 (seq,val) codes
         b.array("ak", S * S, 1)  # Phase2 acks, idx s*S + voter
@@ -386,21 +410,21 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
     def _sv_code(self, seq, val) -> int:
         return self._seq_code(seq) * self.NV + self._val_code(val)
 
-    def _phase_rb(self, s: int, phase) -> int:
-        """The validated req_bit of server ``s``'s active phase: its request
-        id and requester must be the ones this server can coordinate."""
-        rb = 0 if phase.request_id == self._req_id(s, 0) else 1
-        if phase.request_id != self._req_id(s, rb) or int(
-            phase.requester_id
-        ) != self._requester(s, rb):
-            raise self._OverflowError32(f"phase request outside universe: {phase!r}")
-        return rb
+    def _phase_req(self, s: int, phase) -> int:
+        """The validated local request index of server ``s``'s active phase:
+        its request id and requester must be ones this server coordinates."""
+        for r in range(len(self._reqs[s])):
+            if phase.request_id == self._req_id(s, r) and int(
+                phase.requester_id
+            ) == self._requester(s, r):
+                return r
+        raise self._OverflowError32(f"phase request outside universe: {phase!r}")
 
     def _build_families(self):
         def params_for(kind: str, params) -> list:
             if kind == "begin":
-                c, rb = params
-                return [c, rb, self._code_query[(c, rb)]]
+                c, r = params
+                return [c, r, self._code_query[(c, r)]]
             if kind == "putok":
                 (k,) = params
                 return [k, self._code_get[k]]
@@ -408,19 +432,23 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                 k, v = params
                 return [k, 1 + v]  # ReadOk(values[v]) ret code
             if kind == "query":
-                p, c, rb = params
-                return [p, self._base_ackquery[(c, rb)]]
+                p, c, r = params
+                return [p, self._base_ackquery[(c, r)]]
             if kind == "ackquery":
-                c, rb, p, sv = params
-                return [c, rb, p, sv, self._base_record[(c, rb)], 1 + c]
+                c, r, p, sv = params
+                k, req_kind = self._reqs[c][r]
+                is_write = 1 if req_kind == 0 else 0
+                wval = 1 + k if req_kind == 0 else 0
+                return [c, r, p, sv, self._base_record[(c, r)], wval, is_write]
             if kind == "record":
-                p, c, rb, sv = params
-                return [p, sv, self._code_ackrecord[(c, rb)]]
+                p, c, r, sv = params
+                return [p, sv, self._code_ackrecord[(c, r)]]
             # "ackrecord"
-            c, rb, p = params
-            putok = self._code_putok[c] if rb == 0 else 0
-            getok_base = self._base_getok[(c + 1) % self.S] if rb == 1 else 0
-            return [c, rb, p, putok, getok_base]
+            c, r, p = params
+            k, req_kind = self._reqs[c][r]
+            putok = self._code_putok[k] if req_kind == 0 else 0
+            getok_base = self._base_getok[k] if req_kind == 1 else 0
+            return [c, r, p, putok, getok_base, 1 if req_kind == 1 else 0]
 
         return self._group_families(params_for)
 
@@ -443,14 +471,15 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
             fields["seq"][s] = self._seq_code(a.seq)
             fields["val"][s] = self._val_code(a.val)
             if isinstance(a.phase, Phase1):
-                rb = self._phase_rb(s, a.phase)
-                expected_write = (self.values[1 + s],) if rb == 0 else None
+                r = self._phase_req(s, a.phase)
+                k, req_kind = self._reqs[s][r]
+                expected_write = (self.values[1 + k],) if req_kind == 0 else None
                 if a.phase.write != expected_write:
                     raise self._OverflowError32(
                         f"phase write outside universe: {a.phase!r}"
                     )
                 fields["kind"][s] = 1
-                fields["p_req"][s] = rb
+                fields["p_req"][s] = r
                 for key, (sq, v) in a.phase.responses:
                     j = int(key)
                     if not 0 <= j < S:
@@ -458,9 +487,9 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                     fields["rp"][s * S + j] = 1
                     fields["rv"][s * S + j] = self._sv_code(sq, v)
             elif isinstance(a.phase, Phase2):
-                rb = self._phase_rb(s, a.phase)
+                r = self._phase_req(s, a.phase)
                 fields["kind"][s] = 2
-                fields["p_req"][s] = rb
+                fields["p_req"][s] = r
                 if a.phase.read is not None:
                     fields["read"][s] = 1 + self._val_code(a.phase.read[0])
                 for j in a.phase.acks:
@@ -486,9 +515,10 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         actor_states = []
         for s in range(S):
             kind = f["kind"][s]
-            rb = f["p_req"][s]
+            r = f["p_req"][s]
             phase = None
             if kind == 1:
+                k, req_kind = self._reqs[s][r]
                 responses = frozenset(
                     (
                         Id(j),
@@ -501,9 +531,9 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                     if f["rp"][s * S + j]
                 )
                 phase = Phase1(
-                    request_id=self._req_id(s, rb),
-                    requester_id=Id(self._requester(s, rb)),
-                    write=(self.values[1 + s],) if rb == 0 else None,
+                    request_id=self._req_id(s, r),
+                    requester_id=Id(self._requester(s, r)),
+                    write=(self.values[1 + k],) if req_kind == 0 else None,
                     responses=responses,
                 )
             elif kind == 2:
@@ -511,8 +541,8 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
                 if f["read"][s]:
                     read = (self.values[f["read"][s] - 1],)
                 phase = Phase2(
-                    request_id=self._req_id(s, rb),
-                    requester_id=Id(self._requester(s, rb)),
+                    request_id=self._req_id(s, r),
+                    requester_id=Id(self._requester(s, r)),
                     read=read,
                     acks=frozenset(Id(j) for j in range(S) if f["ak"][s * S + j]),
                 )
@@ -548,11 +578,11 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         import jax.numpy as jnp
 
         L, S, u32 = self._layout, self.S, jnp.uint32
-        c, rb, query_code = prm[0], prm[1], prm[2]
+        c, r, query_code = prm[0], prm[1], prm[2]
         deliv, w = self._net_take(words, e)
         ok = deliv & (L.get(words, "kind", c) == 0)
         w = L.set(w, "kind", 1, c)
-        w = L.set(w, "p_req", rb, c)
+        w = L.set(w, "p_req", r, c)
         own = L.get(words, "seq", c) * u32(self.NV) + L.get(words, "val", c)
         w = L.set(w, "rp", 1, c * S + c)
         w = L.set(w, "rv", own, c * S + c)
@@ -579,19 +609,20 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
 
         L, S, u32 = self._layout, self.S, jnp.uint32
         NV = self.NV
-        c, rb, p, sv, record_base, wval = (
+        c, r, p, sv, record_base, wval, is_write_p = (
             prm[0],
             prm[1],
             prm[2],
             prm[3],
             prm[4],
             prm[5],
+            prm[6],
         )
         deliv, w = self._net_take(words, e)
         ok = (
             deliv
             & (L.get(words, "kind", c) == 1)
-            & (L.get(words, "p_req", c) == rb)
+            & (L.get(words, "p_req", c) == r)
         )
         w = L.set(w, "rp", 1, c * S + p)
         w = L.set(w, "rv", sv, c * S + p)
@@ -608,7 +639,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         quorum = count == u32(self.majority)
         best_seq = best // u32(NV)
         clock = best_seq // u32(S)
-        is_write = rb == 0
+        is_write = is_write_p != 0
         o = quorum & is_write & (clock >= u32(self.C))  # clock would overflow
         seq2 = jnp.where(
             is_write, (clock + u32(1)) * u32(S) + u32(c), best_seq
@@ -660,12 +691,19 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         import jax.numpy as jnp
 
         L, S, u32 = self._layout, self.S, jnp.uint32
-        c, rb, p, putok_code, getok_base = prm[0], prm[1], prm[2], prm[3], prm[4]
+        c, r, p, putok_code, getok_base, is_read_p = (
+            prm[0],
+            prm[1],
+            prm[2],
+            prm[3],
+            prm[4],
+            prm[5],
+        )
         deliv, w = self._net_take(words, e)
         ok = (
             deliv
             & (L.get(words, "kind", c) == 2)
-            & (L.get(words, "p_req", c) == rb)
+            & (L.get(words, "p_req", c) == r)
             & (L.get(words, "ak", c * S + p) == 0)
         )
         w = L.set(w, "ak", 1, c * S + p)
@@ -682,7 +720,7 @@ class PackedAbd(reg.PackedClientsMixin, PackedModelAdapter):
         w2 = L.set(w2, "kind", 0, c)
         w2 = L.set(w2, "p_req", 0, c)
         w2 = L.set(w2, "read", 0, c)
-        is_read = rb == 1
+        is_read = is_read_p != 0
         reply = jnp.where(is_read, getok_base + read - u32(1), putok_code)
         w2, dup = self._net_send(w2, reply)
         # A read phase always recorded a read value (read != 0).

@@ -101,15 +101,23 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
     - the ``LinearizabilityTester`` history packs exactly via
       :class:`~stateright_tpu.packing.BoundedHistory` (2 ops/client).
 
-    The ``linearizable`` property is checked EXACTLY on device
-    (``device_linearizable_register``, SURVEY §7 M4 variant (b)): the
-    bounded 2-client history admits a static enumeration of every
-    interleaving the backtracking serializer (linearizability.rs:197-284)
-    would try, fused into the property pass. With one server the model
-    reaches full coverage (93 unique states, single-copy-register.rs:110);
-    with two servers the stale-read counterexample is found on device
+    The consistency property (``linearizable``, or ``sequentially
+    consistent`` under ``consistency="sequential"``) is checked on device
+    via the static interleaving enumeration
+    (:mod:`stateright_tpu.semantics.device`, SURVEY §7 M4 variant (b)) —
+    EXACTLY while the client count keeps the enumeration under
+    ``MAX_PATTERNS`` (<= 3 clients at 2 ops each); beyond that the model
+    declares ``host_verified_properties`` and the device runs a diverse
+    sampled one-sided pass with exact host confirmation of flagged rows
+    (variant (a)). With one server the model reaches full coverage (93
+    unique states at 2 clients, single-copy-register.rs:110); with two
+    servers the stale-read counterexample is found on device
     (single-copy-register.rs:136).
     """
+
+    #: Per-client op bound (one Put then one Get): sizes the packed history
+    #: AND the exact-vs-sampled gate below — one constant, one contract.
+    MAX_OPS = 2
 
     def __init__(
         self,
@@ -126,15 +134,13 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
             client_count, server_count, consistency=consistency
         )
         self._consistency = consistency
-        self._prop_name = (
-            "linearizable" if consistency == "linearizable" else "sequentially consistent"
-        )
+        self._prop_name = self._inner.properties()[0].name
         # Device-exact serialization checking scales to the interleaving
         # budget; past it the property runs as a conservative device pass
         # (a diverse pattern subsample — True proves serializability) with
         # exact host confirmation of the flagged remainder: the engine's
         # host_verified_properties path (xla.py M4 variant (a)).
-        if pattern_count(client_count, 2) > MAX_PATTERNS:
+        if pattern_count(client_count, self.MAX_OPS) > MAX_PATTERNS:
             self.host_verified_properties = frozenset({self._prop_name})
             self._pattern_limit = 20_000
         else:
@@ -169,7 +175,7 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         self._hist = BoundedHistory(
             b,
             thread_ids=[Id(S + k) for k in range(C)],
-            max_ops=2,
+            max_ops=self.MAX_OPS,
             op_bits=op_ret_bits,
             ret_bits=op_ret_bits,
             real_time=consistency == "linearizable",

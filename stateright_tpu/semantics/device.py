@@ -191,13 +191,13 @@ def device_serializable(hist, words, spec, *, real_time: bool, pattern_limit=Non
     M = hist.max_ops
     slots = M + 1
     P = pattern_count(T, M)
-    if pattern_limit is None and P > MAX_PATTERNS:
+    if P > MAX_PATTERNS and (pattern_limit is None or pattern_limit > MAX_PATTERNS):
         raise NotImplementedError(
             f"{P} interleavings ({T} threads x {M}+1 ops) exceeds "
             f"MAX_PATTERNS={MAX_PATTERNS}; declare the property in "
             "host_verified_properties instead (conservative device "
-            "predicate — this function with pattern_limit= — plus exact "
-            "host confirmation)."
+            "predicate — this function with pattern_limit <= MAX_PATTERNS — "
+            "plus exact host confirmation)."
         )
     L_ = hist.layout
     u32 = jnp.uint32

@@ -83,7 +83,63 @@ def test_xla_matches_the_544_state_oracle():
 
 def test_non_oracle_sizes_fall_back_to_host_engines():
     with pytest.raises(ValueError):
-        PackedAbd(2, 3)
+        PackedAbd(2, 3)  # S != 2: quorum arithmetic is single-peer
+    with pytest.raises(ValueError):
+        PackedAbd(4, 2)
     # The object model still checks any size on the host engines.
     c = linearizable_register_model(2, 2).checker().spawn_bfs().join()
     assert c.unique_state_count() == 544
+
+
+def test_three_client_codec_and_step_parity():
+    import jax
+    import jax.numpy as jnp
+
+    m = PackedAbd(3, 2)
+    rng = random.Random(7)
+    init = m._inner.init_states()[0]
+    sample = {init}
+    cur = init
+    for _ in range(8000):
+        steps = list(m._inner.next_steps(cur))
+        if not steps:
+            cur = init
+            continue
+        _, cur = rng.choice(steps)
+        sample.add(cur)
+        if len(sample) >= 120:
+            break
+    states = sorted(sample, key=repr)
+    packed = np.stack([m.pack(s) for s in states])
+    for s, row in zip(states, packed):
+        assert m.unpack(row) == s
+    nxt, valid, ovf = jax.jit(jax.vmap(m.packed_step))(jnp.asarray(packed))
+    nxt, valid, ovf = np.asarray(nxt), np.asarray(valid), np.asarray(ovf)
+    assert not ovf.any()
+    for si, s in enumerate(states):
+        want = {m.pack(ns).tobytes() for _, ns in m._inner.next_steps(s)}
+        got = {
+            nxt[si, a].tobytes() for a in range(m.max_actions) if valid[si, a]
+        }
+        assert got == want, f"step mismatch at state {si}"
+
+
+@pytest.mark.slow
+def test_three_client_full_check_parity():
+    # ABD at 3 clients / 2 servers with EXACT device linearizability over
+    # the 3-thread interleaving enumeration (1,680 patterns/state). The
+    # pinned counts are this package's host-oracle result (spawn_bfs on
+    # linearizable_register_model(3, 2): 68,115 generated / 35,009 unique /
+    # depth 37 — the reference has no oracle for this size).
+    c = (
+        PackedAbd(3, 2)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 16)
+        .join()
+    )
+    c.assert_properties()
+    assert (c.state_count(), c.unique_state_count(), c.max_depth()) == (
+        68115,
+        35009,
+        37,
+    )
