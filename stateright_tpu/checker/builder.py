@@ -49,9 +49,21 @@ class CheckerBuilder:
 
         return DfsChecker(self)
 
-    def spawn_on_demand(self) -> Checker:
+    def spawn_on_demand(self, engine: str = "host", **spawn_kwargs) -> Checker:
         """Demand-driven search: computes nothing until asked
-        (checker.rs:171)."""
+        (checker.rs:171). ``engine="xla"`` runs it on the device engine
+        (packed models; ``spawn_kwargs`` are ``spawn_xla`` capacities) —
+        targeted expansions dispatch compiled super-steps and
+        ``run_to_completion()`` hands over to the fused batch engine."""
+        if engine == "xla":
+            from .device_on_demand import DeviceOnDemandChecker
+
+            return DeviceOnDemandChecker(self, **spawn_kwargs)
+        if spawn_kwargs:
+            raise TypeError(
+                f"spawn kwargs {sorted(spawn_kwargs)} only apply to "
+                'engine="xla"'
+            )
         try:
             from .on_demand import OnDemandChecker
         except ImportError as e:
@@ -86,15 +98,18 @@ class CheckerBuilder:
         kwargs.pop("route_capacity", None)  # sharded-only tuning knob
         return XlaChecker(self, **kwargs)
 
-    def serve(self, addresses) -> Checker:
-        """Starts the interactive Explorer web service (checker.rs:137)."""
+    def serve(self, addresses, engine: str = "auto", **spawn_kwargs) -> Checker:
+        """Starts the interactive Explorer web service (checker.rs:137).
+        Packed models are explored on the DEVICE engine by default
+        (``engine="auto"``); pass ``engine="host"`` to force the Python
+        oracle."""
         try:
             from .explorer import serve
         except ImportError as e:
             raise NotImplementedError(
                 "serve() is not available yet in this build"
             ) from e
-        return serve(self, addresses)
+        return serve(self, addresses, engine=engine, **spawn_kwargs)
 
     # --- configuration ----------------------------------------------------
 

@@ -135,10 +135,19 @@ class ExplorerApp:
         with self._lock:
             model = self._checker.model()
             results = []
+            # The device-backed checker keys pending work by DEVICE
+            # fingerprint, which only the packed codec can compute — it
+            # takes the states themselves (batched: one device dispatch per
+            # request); the host checker takes host fps one at a time.
+            check_states = getattr(self._checker, "check_states", None)
             if not fingerprints:
-                for state in model.init_states():
+                inits = list(model.init_states())
+                if check_states is not None:
+                    check_states(inits)
+                for state in inits:
                     fp = fingerprint(state)
-                    self._checker.check_fingerprint(fp)
+                    if check_states is None:
+                        self._checker.check_fingerprint(fp)
                     results.append(
                         self._state_view(model, None, None, state, [fp])
                     )
@@ -162,12 +171,15 @@ class ExplorerApp:
                 state = model.next_state(last_state, action)
                 if state is not None:
                     fp = fingerprint(state)
-                    self._checker.check_fingerprint(fp)
+                    if check_states is None:
+                        self._checker.check_fingerprint(fp)
                     views.append((action, outcome, state, fp))
                 else:
                     # "Action ignored" is still returned — useful for
                     # debugging (explorer.rs:292-300).
                     views.append((action, None, None, None))
+            if check_states is not None:
+                check_states([s for _, _, s, _ in views if s is not None])
             properties = self._properties()
             for action, outcome, state, fp in views:
                 if state is not None:
@@ -241,14 +253,19 @@ def _pretty(state: Any) -> str:
         return repr(state)
 
 
-def serve(builder, addresses):
+def serve(builder, addresses, engine: str = "auto", **spawn_kwargs):
     """Starts the Explorer web service; blocks forever (checker.rs:137-144).
 
     ``addresses`` is a ``"host:port"`` string or ``(host, port)`` tuple.
-    Returns the checker (for tests that build the service without blocking,
-    use :func:`make_app`).
+    ``engine`` selects the demand-driven backend: ``"host"`` (the Python
+    oracle), ``"xla"`` (the device engine,
+    :class:`~stateright_tpu.checker.device_on_demand.DeviceOnDemandChecker`),
+    or ``"auto"`` — xla whenever the model is packed, like the reference
+    Explorer wrapping its real engine (explorer.rs:81-103). Returns the
+    checker (for tests that build the service without blocking, use
+    :func:`make_app`).
     """
-    app, checker = make_app(builder)
+    app, checker = make_app(builder, engine=engine, **spawn_kwargs)
     host, port = _parse_address(addresses)
 
     class Handler(_ExplorerHandler):
@@ -265,11 +282,28 @@ def serve(builder, addresses):
     return checker
 
 
-def make_app(builder):
+def make_app(builder, engine: str = "auto", **spawn_kwargs):
     """Builds the Explorer app + demand-driven checker without binding a
-    socket (the test entry point, mirroring explorer.rs:314-351)."""
+    socket (the test entry point, mirroring explorer.rs:314-351). See
+    :func:`serve` for ``engine``; ``spawn_kwargs`` reach the device
+    checker (capacities etc.)."""
+    from ..xla import is_packed
+
     snapshot = Snapshot()
-    checker = builder.visitor(snapshot.visit).spawn_on_demand()
+    if engine == "xla" or (engine == "auto" and is_packed(builder._model)):
+        from .device_on_demand import DeviceOnDemandChecker
+
+        # The snapshot visitor would force one-level dispatches in batch
+        # mode; the device Explorer favors the fused run-to-completion and
+        # leaves the recent-path panel to the host backend.
+        checker = DeviceOnDemandChecker(builder, **spawn_kwargs)
+    else:
+        if spawn_kwargs:
+            raise TypeError(
+                f"spawn kwargs {sorted(spawn_kwargs)} only apply to the "
+                "device engine; this model resolves to the host backend"
+            )
+        checker = builder.visitor(snapshot.visit).spawn_on_demand()
     return ExplorerApp(checker, snapshot), checker
 
 

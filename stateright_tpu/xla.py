@@ -72,10 +72,26 @@ from .core import Expectation, Model
 from .ops import fphash, hashset
 
 
+#: The PackedModel protocol surface (module docstring above).
+PACKED_ATTRS = (
+    "state_words",
+    "max_actions",
+    "packed_init",
+    "packed_step",
+    "packed_properties",
+)
+
+
+def is_packed(model: Model) -> bool:
+    """Whether ``model`` implements the PackedModel protocol (and so can
+    run on the device engines)."""
+    return all(hasattr(model, attr) for attr in PACKED_ATTRS)
+
+
 def _require_packed(model: Model) -> None:
     missing = [
         attr
-        for attr in ("state_words", "max_actions", "packed_init", "packed_step", "packed_properties")
+        for attr in PACKED_ATTRS
         if not hasattr(model, attr)
     ]
     if missing:
