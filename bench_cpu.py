@@ -45,6 +45,7 @@ def main() -> None:
     from stateright_tpu.actor import Network
     from stateright_tpu.models.increment_lock import IncrementLock
     from stateright_tpu.models.linearizable_register import (
+        PackedAbd,
         linearizable_register_model,
     )
     from stateright_tpu.models.paxos import PackedPaxos, paxos_model
@@ -106,6 +107,23 @@ def main() -> None:
             lambda: PackedSingleCopyRegister(2, 1)
             .checker()
             .spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 12),
+        ),
+        # Round-3 configurations: 3-thread device-exact linearizability.
+        (
+            "linearizable-register 3c/2s, host bfs",
+            lambda: linearizable_register_model(3, 2).checker().spawn_bfs(),
+        ),
+        (
+            "linearizable-register 3c/2s packed, spawn_xla cpu",
+            lambda: PackedAbd(3, 2)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 16),
+        ),
+        (
+            "single-copy-register 3c/1s packed, spawn_xla cpu",
+            lambda: PackedSingleCopyRegister(3, 1)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 11, table_capacity=1 << 14),
         ),
     ]
     for name, build in configs:
