@@ -153,9 +153,12 @@ def _run_matrix(platform: str) -> list:
             dict(frontier_capacity=1 << 12, table_capacity=1 << 16),
         ),
         (
-            "single-copy-register 2c/1s packed",
-            lambda: PackedSingleCopyRegister(2, 1),
-            dict(frontier_capacity=1 << 10, table_capacity=1 << 12),
+            # BASELINE.json's "single-copy-register check 3": 3 clients,
+            # linearizability checked device-exact over the 3-thread
+            # interleaving enumeration.
+            "single-copy-register 3c/1s packed",
+            lambda: PackedSingleCopyRegister(3, 1),
+            dict(frontier_capacity=1 << 11, table_capacity=1 << 14),
         ),
         (
             "increment_lock 3t packed",

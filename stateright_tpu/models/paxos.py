@@ -243,10 +243,13 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
         from ..actor.network import Envelope
         from ..packing import BoundedHistory, LayoutBuilder, OverflowError32
 
-        if client_count != 2:
+        from ..semantics.device import MAX_PATTERNS, pattern_count
+
+        if pattern_count(client_count, 2) > MAX_PATTERNS:
             raise ValueError(
-                "the packed model's exact device linearizability covers the "
-                "2-client shape; other sizes run on the host engines"
+                f"{client_count} clients exceed the exact device "
+                "linearizability budget (semantics.device.MAX_PATTERNS); "
+                "larger sizes run on the host engines"
             )
         C, S = client_count, server_count
         self.C, self.S = C, S

@@ -94,3 +94,40 @@ def test_xla_matches_the_16668_state_oracle():
         isinstance(env.msg, reg.GetOk) and env.msg.value is not None
         for env in final.network.iter_deliverable()
     )
+
+
+@pytest.mark.slow
+def test_three_client_codec_step_and_bounded_parity():
+    """Paxos at 3 clients / 3 servers (the BASELINE.json ``paxos check 3``
+    config): codec round-trips, device step parity on a reachable sample,
+    and exact bounded-depth count parity against the host oracle (depth 8:
+    3,279 generated / 1,969 unique). The full 3-client space is far past
+    oracle range; full-coverage runs are device-engine territory."""
+    import jax
+    import jax.numpy as jnp
+
+    m = PackedPaxos(3, 3)
+    states = _sample_states(m._inner, 100)
+    packed = np.stack([m.pack(s) for s in states])
+    for s, row in zip(states, packed):
+        assert m.unpack(row) == s
+    nxt, valid, ovf = jax.jit(jax.vmap(m.packed_step))(jnp.asarray(packed))
+    nxt, valid, ovf = np.asarray(nxt), np.asarray(valid), np.asarray(ovf)
+    assert not ovf.any()
+    for si, s in enumerate(states):
+        want = {m.pack(ns).tobytes() for _, ns in m._inner.next_steps(s)}
+        got = {nxt[si, a].tobytes() for a in range(m.max_actions) if valid[si, a]}
+        assert got == want, f"step mismatch at state {si}"
+
+    h = paxos_model(3, 3).checker().target_max_depth(8).spawn_bfs().join()
+    c = (
+        PackedPaxos(3, 3)
+        .checker()
+        .target_max_depth(8)
+        .spawn_xla(frontier_capacity=1 << 13, table_capacity=1 << 17)
+        .join()
+    )
+    assert (c.state_count(), c.unique_state_count()) == (
+        h.state_count(),
+        h.unique_state_count(),
+    ) == (3279, 1969)
