@@ -125,12 +125,12 @@ def _run_matrix(platform: str) -> list:
     engine. Warm + measured pass each; small spaces, so these anchor
     time-to-coverage rather than steady-state throughput."""
     from stateright_tpu.models.increment_lock import PackedIncrementLock
-    from stateright_tpu.models.linearizable_register import PackedAbd
-    from stateright_tpu.models.paxos import PackedPaxos
-    from stateright_tpu.models.single_copy_register import (
-        PackedSingleCopyRegister,
-        PackedSingleCopyRegisterOrdered,
+    from stateright_tpu.models.linearizable_register import (
+        PackedAbd,
+        PackedAbdOrdered,
     )
+    from stateright_tpu.models.paxos import PackedPaxos
+    from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
 
     rows = []
     for name, build, kwargs in [
@@ -140,11 +140,11 @@ def _run_matrix(platform: str) -> list:
             dict(frontier_capacity=1 << 10, table_capacity=1 << 12),
         ),
         (
-            # The reference harness's ordered-channel config (bench.sh:33
-            # runs `linearizable-register check 3 ordered`); the packed
-            # ordered-network model is the single-copy register (FifoLanes).
-            "single-copy-register 2c/1s ordered packed",
-            lambda: PackedSingleCopyRegisterOrdered(2),
+            # The reference harness's ordered-channel config: BASELINE.json's
+            # `linearizable-register check 2 ordered` (bench.sh:33 runs the
+            # same model at 3 clients) — ABD over FifoLanes.
+            "linearizable-register (ABD) 2c/2s ordered packed",
+            lambda: PackedAbdOrdered(2, 2),
             dict(frontier_capacity=1 << 10, table_capacity=1 << 12),
         ),
         (

@@ -1,0 +1,103 @@
+"""Packed ordered-network ABD: FifoLanes over the quorum protocol.
+
+The reference harness's ``linearizable-register check 2 ordered`` config
+(bench.sh:33, BASELINE.json). The reference has no exact-count oracle for
+ordered ABD, so parity is engine-vs-engine: the packed FifoLanes model must
+agree action-for-action and in full coverage with this package's object
+``OrderedNetwork`` model (which passes the reference's ordered-semantics
+regression matrix, model.rs:795-964).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from stateright_tpu.actor import Network
+from stateright_tpu.models.linearizable_register import (
+    PackedAbdOrdered,
+    linearizable_register_model,
+)
+
+
+def _sample_states(inner, n, seed=5):
+    rng = random.Random(seed)
+    init = inner.init_states()[0]
+    sample = {init}
+    cur = init
+    for _ in range(6000):
+        steps = list(inner.next_steps(cur))
+        if not steps:
+            cur = init
+            continue
+        _, cur = rng.choice(steps)
+        sample.add(cur)
+        if len(sample) >= n:
+            break
+    return sorted(sample, key=repr)
+
+
+def test_codec_round_trips_and_step_parity():
+    import jax
+    import jax.numpy as jnp
+
+    m = PackedAbdOrdered(2, 2)
+    states = _sample_states(m._inner, 150)
+    packed = np.stack([m.pack(s) for s in states])
+    for s, row in zip(states, packed):
+        assert m.unpack(row) == s
+    nxt, valid, ovf = jax.jit(jax.vmap(m.packed_step))(jnp.asarray(packed))
+    nxt, valid, ovf = np.asarray(nxt), np.asarray(valid), np.asarray(ovf)
+    assert not ovf.any()
+    for si, s in enumerate(states):
+        want = {m.pack(ns).tobytes() for _, ns in m._inner.next_steps(s)}
+        got = {
+            nxt[si, a].tobytes() for a in range(m.max_actions) if valid[si, a]
+        }
+        assert got == want, f"step mismatch at state {si}"
+
+
+def test_full_coverage_matches_host_engine():
+    h = (
+        linearizable_register_model(2, 2, Network.new_ordered())
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    c = (
+        PackedAbdOrdered(2, 2)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 12)
+        .join()
+    )
+    assert (c.state_count(), c.unique_state_count(), c.max_depth()) == (
+        h.state_count(),
+        h.unique_state_count(),
+        h.max_depth(),
+    ) == (813, 564, 25)
+    c.assert_properties()
+    assert len(c.discoveries()["value chosen"]) == len(
+        h.discoveries()["value chosen"]
+    )
+
+
+@pytest.mark.slow
+def test_three_client_full_coverage_parity():
+    # 3 clients over ordered channels with device-exact 3-thread
+    # linearizability: 63,053 generated / 36,213 unique (engine-vs-engine;
+    # pinned from the host oracle run).
+    c = (
+        PackedAbdOrdered(3, 2)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 11, table_capacity=1 << 14)
+        .join()
+    )
+    c.assert_properties()
+    assert (c.state_count(), c.unique_state_count()) == (63053, 36213)
+
+
+def test_invalid_sizes_raise():
+    with pytest.raises(ValueError):
+        PackedAbdOrdered(2, 3)
+    with pytest.raises(ValueError):
+        PackedAbdOrdered(4, 2)
