@@ -10,7 +10,13 @@ evidence-driven rather than guesswork:
 4. hash-set insert cost vs batch size (the scatter-heavy op most likely to
    be TPU-hostile).
 
-Usage: python tools/microbench.py [rm] [--pow P ...]
+Usage: python tools/microbench.py [rm] [--cpu]
+
+``--cpu`` pins the CPU backend at config level BEFORE first backend use —
+without it the script initializes the session's default backend, which on
+this container is the axon TPU plugin and can WEDGE while the tunnel is
+down (the CLAUDE.md gotcha; tpu_plan.sh runs it un-pinned on purpose,
+after a successful probe).
 """
 
 from __future__ import annotations
@@ -34,6 +40,10 @@ def timeit(fn, *args, n=5):
 
 def main() -> None:
     import jax
+
+    if "--cpu" in sys.argv:
+        sys.argv.remove("--cpu")
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     rm = int(sys.argv[1]) if len(sys.argv) > 1 else 6

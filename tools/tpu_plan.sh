@@ -43,4 +43,41 @@ EOF
 
 log "stage 4: full bench"
 python bench.py
-log "done; see BENCH output above, bench_detail.json, bench_probe.log"
+
+log "stage 5: device-scale soak (results -> tpu_soak.log)"
+# Two runs per config: full-coverage counts must be stable run-to-run.
+timeout 3600 python - <<'EOF' 2>&1 | tee tpu_soak.log
+import os, time
+import jax
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+def soak(name, build, runs=2, budget_s=900, **kw):
+    import jax.numpy as jnp
+    results = []
+    for i in range(runs):
+        model = build()
+        c = model.checker().spawn_xla(**kw)
+        t0 = time.monotonic()
+        while not c.is_done() and time.monotonic() - t0 < budget_s:
+            c._run_block()
+        dt = time.monotonic() - t0
+        results.append((c.state_count(), c.unique_state_count(), c.max_depth(), c.is_done()))
+        print(f"[soak] {name} run {i}: gen={c.state_count():,} uniq={c.unique_state_count():,} "
+              f"depth={c.max_depth()} done={c.is_done()} in {dt:.1f}s "
+              f"({c.state_count()/max(dt,1e-9):,.0f} gen/s) table=2^{c._table.capacity.bit_length()-1}",
+              flush=True)
+    stable = len(set(results)) == 1
+    print(f"[soak] {name}: counts {'STABLE' if stable else 'UNSTABLE'} across {runs} runs", flush=True)
+
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+soak("2pc rm=10", lambda: PackedTwoPhaseSys(10),
+     frontier_capacity=1 << 20, table_capacity=1 << 25)
+soak("2pc rm=12", lambda: PackedTwoPhaseSys(12), budget_s=1200,
+     frontier_capacity=1 << 21, table_capacity=1 << 27)
+from stateright_tpu.models.paxos import PackedPaxos
+soak("paxos 3c/3s", lambda: PackedPaxos(3, 3), budget_s=1200,
+     frontier_capacity=1 << 19, table_capacity=1 << 25)
+EOF
+
+log "done; see BENCH output above, bench_detail.json, bench_probe.log, tpu_soak.log"
