@@ -79,15 +79,13 @@ def _run_check(model, detail: list | None, budget_s: float = float("inf"), **spa
     blocks until done or out of budget; returns (generated_states, seconds,
     checker, completed). The budget means an arbitrarily large ``BENCH_RM``
     space still yields a steady-state number in bounded time."""
-    # spawn_xla's learned-capacity hints only apply to DEFAULT capacities
-    # (an explicit request must win — see xla.py); the bench passes explicit
-    # capacities, so merge the hints here: the measured pass must start at
-    # whatever the warm pass grew to, not re-pay the rehash-and-rerun.
-    from stateright_tpu.xla import capacity_hints
-
-    spawn_kwargs = dict(spawn_kwargs)
-    for key, hint in capacity_hints(model).items():
-        spawn_kwargs[key] = max(spawn_kwargs.get(key, 0), hint)
+    # Deliberately IDENTICAL spawn kwargs for the warm and measured passes
+    # (the learned-capacity hints are NOT merged in): every grown capacity
+    # changes array shapes, so a measured pass spawned at the warm pass's
+    # grown capacities re-traces every bucket program — paying minutes of
+    # XLA compile to save a millisecond rehash. With identical kwargs the
+    # measured pass replays the warm schedule (including the same proactive
+    # growth points) and hits the compile cache at every step.
     checker = model.checker().spawn_xla(**spawn_kwargs)
     t0 = time.monotonic()
     while not checker.is_done():

@@ -809,6 +809,19 @@ class ShardedXlaChecker(Checker):
 
     # --- growth -----------------------------------------------------------
 
+    def _grow_table_if_loaded(self) -> None:
+        """Same proactive-growth policy as the single-chip engine
+        (xla.py MAX_LOAD_*): keep the global load factor at or below 1/4 so
+        inserts never pay long probe chains. Uniform fingerprint ownership
+        keeps per-shard load within noise of the global figure."""
+        from ..xla import XlaChecker
+
+        while (
+            self._unique_count * XlaChecker.MAX_LOAD_DEN
+            > self._D * self._Cl * XlaChecker.MAX_LOAD_NUM
+        ):
+            self._grow_table()
+
     def _grow_table(self) -> None:
         """Double every shard's table partition (ownership is capacity-
         independent, so rehash stays shard-local)."""
@@ -983,6 +996,9 @@ class ShardedXlaChecker(Checker):
             if committed:
                 self._max_depth = max(self._max_depth, self._depth - 1)
             budget_left -= committed
+            Cl_before = self._Cl
+            self._grow_table_if_loaded()
+            grew_proactively = self._Cl > Cl_before
             self._pin_found_names()
             if (
                 self._target_state_count is not None
@@ -994,7 +1010,10 @@ class ShardedXlaChecker(Checker):
             if c_ovf:
                 self._raise_codec_overflow()
             if t_ovf:
-                self._grow_table()
+                # Only grow again if the proactive pass above did not just
+                # double past the blockage (see xla.py).
+                if not grew_proactively:
+                    self._grow_table()
                 continue
             if f_ovf:
                 self._grow_frontier()
@@ -1051,6 +1070,7 @@ class ShardedXlaChecker(Checker):
         self._state_count += int(np.asarray(d_states))
         self._unique_count += int(np.asarray(d_unique))
         self._depth += 1
+        self._grow_table_if_loaded()
         self._pin_found_names()
         if (
             self._target_state_count is not None

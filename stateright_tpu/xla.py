@@ -104,8 +104,11 @@ def _require_packed(model: Model) -> None:
 def capacity_hints(model: Model) -> Dict[str, int]:
     """Capacities learned from growth events in earlier single-chip checks
     of ``model`` (empty if none grew). Hints auto-apply only to DEFAULT
-    capacities; a caller that passes explicit capacities but wants the
-    carryover merges these in itself (bench.py's measured pass does)."""
+    capacities; a caller that passes explicit capacities may merge these in
+    to pre-size a fresh run — but note that repeated runs that want the
+    COMPILE cache warm should pass identical capacities instead, replaying
+    the first run's (shape, bucket) schedule (every grown capacity is a new
+    array shape, i.e. a recompile; bench.py's warm/measured passes)."""
     out: Dict[str, int] = {}
     if "_xla_table_cap_hint" in model.__dict__:
         out["table_capacity"] = model.__dict__["_xla_table_cap_hint"]
@@ -727,6 +730,23 @@ class XlaChecker(Checker):
             self._superstep_cache[key] = fn
         return fn
 
+    #: Proactive-growth trigger: keep the open-addressing table at or below
+    #: this load factor. Probe-chain length (the dominant insert cost — see
+    #: BASELINE.md's cost model) grows superlinearly with load; growing at
+    #: 1/4 load bounds probe rounds at a 4x memory cost over the uniques.
+    MAX_LOAD_NUM, MAX_LOAD_DEN = 1, 4
+
+    def _grow_table_if_loaded(self) -> None:
+        """Double the table whenever the committed unique count crosses the
+        load ceiling — BEFORE inserts start paying long probe chains (the
+        reactive path only grows on probe-failure overflow, by which point
+        the load factor is far past the cheap regime)."""
+        while (
+            self._unique_count * self.MAX_LOAD_DEN
+            > self._table.capacity * self.MAX_LOAD_NUM
+        ):
+            self._grow_table()
+
     def _grow_table(self) -> None:
         """Rehash the visited set into a table of twice the capacity."""
         import jax
@@ -911,6 +931,9 @@ class XlaChecker(Checker):
             if committed:
                 self._max_depth = max(self._max_depth, self._depth - 1)
             budget_left -= committed
+            cap_before = self._table.capacity
+            self._grow_table_if_loaded()
+            grew_proactively = self._table.capacity > cap_before
             if self._hv_idx:
                 self._confirm_hv_candidates(hv_w, hv_f, hv_c)
             self._pin_found_names()
@@ -924,7 +947,11 @@ class XlaChecker(Checker):
             if c_ovf:
                 self._raise_codec_overflow()
             if t_ovf:
-                self._grow_table()
+                # The proactive pass above may already have doubled past
+                # the blockage; only grow again if it did not (every extra
+                # doubling is 2x memory AND a fresh shape compile).
+                if not grew_proactively:
+                    self._grow_table()
                 continue
             if f_ovf:
                 run_cap = self._grow_frontier(run_cap)
@@ -1018,6 +1045,7 @@ class XlaChecker(Checker):
         self._state_count += int(d_states)
         self._unique_count += int(d_unique)
         self._depth += 1
+        self._grow_table_if_loaded()
         if self._hv_idx:
             self._confirm_hv_candidates(hv_words, hv_fps, hv_counts)
         self._pin_found_names()
