@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Background tunnel watcher: probe the axon TPU tunnel until it answers,
-# then run the staged measurement plan (tools/tpu_plan.sh) once and exit.
-# All output -> tpu_watch.log. Probe itself is cheap (one import attempt);
-# the heavy stages only start after a successful probe.
+# then run the staged measurement plan (tools/tpu_plan.sh). A plan run that
+# fails (tunnel dropped mid-way) goes back to probing; a successful plan
+# ends the watch. All output -> tpu_watch.log. Probes are cheap (one import
+# attempt under a 60s watchdog, every 4 min).
 set -u
 cd "$(dirname "$0")/.."
 LOG=tpu_watch.log
@@ -17,8 +18,12 @@ while true; do
     bash tools/tpu_plan.sh >>"$LOG" 2>&1
     rc=$?
     log "tpu_plan.sh finished rc=$rc"
-    exit $rc
+    if [ "$rc" -eq 0 ]; then
+      exit 0
+    fi
+    log "plan failed; resuming probe loop"
+  else
+    log "probe $attempt: tunnel down"
   fi
-  log "probe $attempt: tunnel down"
-  sleep 540
+  sleep 240
 done
