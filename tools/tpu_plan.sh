@@ -1,12 +1,17 @@
 #!/usr/bin/env bash
 # The TPU measurement plan, one command, each stage under its own watchdog.
-# Run when the axon tunnel recovers (probe first). Stages:
+# Run when the axon tunnel recovers (probe first). Ordered so the single
+# most important artifact — the committed primary bench number — lands
+# FIRST: tunnel windows have been short and rare, and a window spent on the
+# microbench with the tunnel dropping before the bench would repeat the
+# round-2 failure. Stages:
 #  1. probe            — is the chip reachable at all?
-#  2. microbench       — dispatch RTT, superstep compile/steady per bucket,
+#  2. bench            — the full primary metric + config matrix.
+#  3. microbench       — dispatch RTT, superstep compile/steady per bucket,
 #                        hashset insert vs two-key sort (the hash-scatter vs
 #                        sort-dedup design decision), compaction styles.
-#  3. pallas check     — does the opt-in Pallas insert lower on hardware?
-#  4. bench            — the full primary metric + config matrix.
+#  4. pallas check     — does the opt-in Pallas insert lower on hardware?
+#  5. soak             — device-scale full-coverage runs, stability-checked.
 set -u
 cd "$(dirname "$0")/.."
 log() { echo "[tpu_plan $(date +%H:%M:%S)] $*"; }
@@ -17,10 +22,13 @@ if ! timeout 60 python -c "import jax; ds=jax.devices(); print(ds); assert ds[0]
   exit 1
 fi
 
-log "stage 2: microbench (results -> tpu_microbench.log)"
+log "stage 2: full bench (the primary artifact)"
+python bench.py
+
+log "stage 3: microbench (results -> tpu_microbench.log)"
 timeout 1800 python tools/microbench.py 6 2>&1 | tee tpu_microbench.log
 
-log "stage 3: compiled Pallas insert probe"
+log "stage 4: compiled Pallas insert probe"
 timeout 600 python - <<'EOF' 2>&1 | tee tpu_pallas.log
 import numpy as np
 import jax, jax.numpy as jnp
@@ -40,9 +48,6 @@ try:
 except Exception as e:
     print(f"pallas compiled insert FAILED to lower/run: {type(e).__name__}: {e}")
 EOF
-
-log "stage 4: full bench"
-python bench.py
 
 log "stage 5: device-scale soak (results -> tpu_soak.log)"
 # Two runs per config: full-coverage counts must be stable run-to-run.
