@@ -84,6 +84,56 @@ def test_run_to_completion_uses_fused_batch_engine():
     assert props["commit agreement"] and props["abort agreement"]
 
 
+def test_device_explorer_live_socket_smoke():
+    """One real HTTP round-trip against the DEVICE backend: status, init
+    states, a click (device super-step expansion), and run-to-completion —
+    the browser contract end-to-end with the packed engine underneath."""
+    import json
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from stateright_tpu.checker.explorer import _ExplorerHandler
+
+    app, checker = make_app(PackedTwoPhaseSys(3).checker(), **KW)
+    assert isinstance(checker, DeviceOnDemandChecker)
+
+    class Handler(_ExplorerHandler):
+        explorer_app = app
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as resp:
+            return json.load(resp)
+
+    try:
+        status = get("/.status")
+        assert status["model"] == "PackedTwoPhaseSys"
+        inits = get("/.states/")
+        assert len(inits) == 1
+        children = get("/.states/" + inits[0]["fingerprint"])
+        assert sum("state" in v for v in children) == 7
+        assert get("/.status")["unique_state_count"] > 1
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/.runtocompletion", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        while not checker.is_done():
+            app.drive()
+        final = get("/.status")
+        assert final["done"] and final["unique_state_count"] == 288
+    finally:
+        server.shutdown()
+        t.join(timeout=5)
+
+
 def test_join_before_unblock_raises():
     import pytest
 
