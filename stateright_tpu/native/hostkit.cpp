@@ -51,6 +51,7 @@ void fingerprint_words(const uint32_t* words, int64_t n, int64_t w,
         uint32_t hi = fmix32(fold_hi ^ 0x9E3779B9u);
         uint32_t lo = fmix32(fold_lo ^ 0x517CC1B7u);
         if (hi == 0 && lo == 0) lo = 1;  // reserve EMPTY sentinel
+        if (hi == 0xFFFFFFFFu && lo == 0xFFFFFFFFu) lo = 0xFFFFFFFEu;  // reserve sorted-set pad key
         out_hi[r] = hi;
         out_lo[r] = lo;
     }

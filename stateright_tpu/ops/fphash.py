@@ -24,7 +24,9 @@ the host flavor during path reconstruction — and in C++
 (``native/hostkit.cpp``), so three-way agreement is load-bearing and covered
 by differential tests.
 
-The pair (0, 0) is reserved as the hash-set EMPTY sentinel and is remapped.
+The pairs (0, 0) (the EMPTY sentinel of both visited-set layouts) and
+(0xFFFFFFFF, 0xFFFFFFFF) (the sorted set's pad key, ops/sortedset.py) are
+reserved and remapped.
 """
 
 from __future__ import annotations
@@ -83,9 +85,12 @@ def fingerprint_words(words, xp):
         # ...then one avalanche over the seeded fold.
         hi = _fmix32(fold_hi ^ u(_SEED_HI), xp)
         lo = _fmix32(fold_lo ^ u(_SEED_LO), xp)
-        # Reserve (0, 0) for the hash-set EMPTY sentinel.
-        is_sentinel = (hi == u(0)) & (lo == u(0))
-        lo = xp.where(is_sentinel, u(1), lo)
+        # Reserve (0, 0) (the EMPTY sentinel of both visited-set layouts)
+        # and (0xFFFFFFFF, 0xFFFFFFFF) (the sorted set's in-sort pad key).
+        is_empty = (hi == u(0)) & (lo == u(0))
+        lo = xp.where(is_empty, u(1), lo)
+        is_full = (hi == u(0xFFFFFFFF)) & (lo == u(0xFFFFFFFF))
+        lo = xp.where(is_full, u(0xFFFFFFFE), lo)
         return hi, lo
 
 

@@ -1,0 +1,193 @@
+"""Sort-merge visited set: the TPU-native dedup structure.
+
+The round-2 visited set (``ops/hashset.py``) is an open-addressing table
+whose batched insert runs claim-election rounds of gathers and scatters.
+That shape is right for CPUs and wrong for TPUs: XLA:TPU executes the
+per-round scatters effectively serially, and the on-chip cost model
+(BASELINE.md, ``tpu_microbench.log``) measured the insert at 0.24 M ins/s
+for a 2^22 batch — 17.3 seconds — while ``lax.sort`` moved the same batch
+in ~3 ms.  On a TPU, **sort is the hash table**.
+
+This module keeps the visited set as a key-sorted array instead.  One
+multi-key ``lax.sort`` of ``[visited ‖ candidates]`` per level performs,
+simultaneously:
+
+- membership (a candidate equal to a visited key lands in that key's run,
+  behind it),
+- in-batch dedup with the same determinism rule as the hash insert (the
+  lowest original batch index wins: the original index is the sort's
+  tie-break key),
+- the merge (survivors are already in key order; a stable compaction
+  restores the dense sorted prefix).
+
+It replaces the concurrent visited map of the reference's BFS core
+(``/root/reference/src/checker/bfs.rs:29-31, 349-363``) just like the
+hash set did, stores the same parent-fingerprint values for witness
+reconstruction, and its planes keep the hash set's external layout
+contract — occupied rows have non-(0,0) keys, pads are zeros — so the
+checkpoint codec and the native ``ParentMap`` consume either structure
+unchanged.  ``(0xFFFFFFFF, 0xFFFFFFFF)`` is additionally reserved (the
+in-sort pad sentinel, remapped by ``ops/fphash.py`` exactly like (0,0)).
+
+Unlike the hash set there is no probe budget and no rehash: growth is a
+plain copy into bigger planes, and capacity overflow is detected exactly
+(merged count > capacity) rather than probabilistically.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+
+class SortedSet(NamedTuple):
+    """First ``n`` rows of the planes are sorted ascending by (hi, lo) and
+    unique; rows at ``n`` and beyond are (0, 0) pads."""
+
+    key_hi: "jax.Array"  # [C] uint32
+    key_lo: "jax.Array"  # [C] uint32
+    val_hi: "jax.Array"  # [C] uint32
+    val_lo: "jax.Array"  # [C] uint32
+    n: "jax.Array"  # [] int32 — occupied prefix length
+
+    @property
+    def capacity(self) -> int:
+        return self.key_hi.shape[0]
+
+
+def make(capacity: int, xp) -> SortedSet:
+    """An empty sorted set with ``capacity`` row slots (power of two)."""
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    z = xp.zeros((capacity,), dtype=xp.uint32)
+    return SortedSet(z, z, z, z, xp.asarray(0, dtype=xp.int32))
+
+
+def from_entries(key_hi, key_lo, val_hi, val_lo, capacity: int, xp) -> SortedSet:
+    """Host-side bulk build from unique (key, value) pairs (checkpoint
+    restore, init seeding).  Sorts once with numpy; no device round-trips."""
+    key_hi = np.asarray(key_hi, np.uint32)
+    key_lo = np.asarray(key_lo, np.uint32)
+    val_hi = np.asarray(val_hi, np.uint32)
+    val_lo = np.asarray(val_lo, np.uint32)
+    n = len(key_hi)
+    if capacity < n or capacity & (capacity - 1):
+        raise ValueError(f"capacity {capacity} cannot hold {n} sorted entries")
+    order = np.lexsort((key_lo, key_hi))
+    planes = []
+    for a in (key_hi[order], key_lo[order], val_hi[order], val_lo[order]):
+        out = np.zeros(capacity, np.uint32)
+        out[:n] = a
+        planes.append(xp.asarray(out))
+    return SortedSet(*planes, xp.asarray(n, dtype=xp.int32))
+
+
+def insert(
+    ss: SortedSet,
+    fp_hi,
+    fp_lo,
+    val_hi,
+    val_lo,
+    active,
+    *,
+    max_probes: int = 0,  # accepted for hashset signature compatibility; unused
+) -> Tuple[SortedSet, "jax.Array", "jax.Array"]:
+    """Insert a batch; returns ``(ss', is_new, overflow)``.
+
+    Semantics match ``hashset.insert`` exactly: ``is_new[i]`` (in the
+    original batch order) marks the single winner among in-batch
+    duplicates — the lowest batch index — of a key not already present;
+    winners' values are stored; ``overflow`` (scalar) reports that the
+    merged set does not fit the capacity, in which case the caller grows
+    and retries (the returned set is truncated and must be discarded).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cap = ss.capacity
+    m = fp_hi.shape[0]
+    total = cap + m
+    full = jnp.uint32(0xFFFFFFFF)
+
+    # Pad rows (unoccupied visited slots, inactive candidates) get the
+    # reserved all-ones key so they sort to the tail as one run.
+    vis_valid = jnp.arange(cap) < ss.n
+    kh = jnp.concatenate([jnp.where(vis_valid, ss.key_hi, full), jnp.where(active, fp_hi, full)])
+    kl = jnp.concatenate([jnp.where(vis_valid, ss.key_lo, full), jnp.where(active, fp_lo, full)])
+    # Tie-break ticket: visited rows carry 0 so they sort ahead of any
+    # equal-key candidate; candidates carry 1 + original index, making the
+    # sort key triple unique (visited keys are unique by invariant) and
+    # the whole pipeline deterministic by construction.
+    ticket = jnp.concatenate(
+        [jnp.zeros((cap,), jnp.int32), 1 + jnp.arange(m, dtype=jnp.int32)]
+    )
+    vh = jnp.concatenate([ss.val_hi, val_hi])
+    vl = jnp.concatenate([ss.val_lo, val_lo])
+
+    skh, skl, st, svh, svl = jax.lax.sort((kh, kl, ticket, vh, vl), num_keys=3)
+
+    run_start = jnp.concatenate(
+        [
+            jnp.ones((1,), jnp.bool_),
+            (skh[1:] != skh[:-1]) | (skl[1:] != skl[:-1]),
+        ]
+    )
+    real = ~((skh == full) & (skl == full))
+    is_cand = st > 0
+    winner = run_start & is_cand & real  # run has no visited row, lowest ticket
+    keep = real & (winner | ~is_cand)  # surviving = old rows + new winners
+    new_n = jnp.sum(keep, dtype=jnp.int32)
+    overflow = new_n > cap
+
+    # Stable compaction of survivors to the front keeps them key-sorted.
+    order = jnp.argsort(~keep, stable=True)[:cap]
+    row_ok = jnp.arange(cap) < jnp.minimum(new_n, cap)
+    z = jnp.uint32(0)
+    nkh = jnp.where(row_ok, skh[order], z)
+    nkl = jnp.where(row_ok, skl[order], z)
+    nvh = jnp.where(row_ok, svh[order], z)
+    nvl = jnp.where(row_ok, svl[order], z)
+
+    # Route is_new back to original batch order. Winner tickets are unique,
+    # so the scatter is conflict-free; non-winners are routed out of range.
+    idx = jnp.where(winner, st - 1, m)
+    is_new = jnp.zeros((m,), jnp.bool_).at[idx].set(True, mode="drop")
+
+    return SortedSet(nkh, nkl, nvh, nvl, jnp.minimum(new_n, cap)), is_new, overflow
+
+
+def lookup(ss: SortedSet, fp_hi, fp_lo, *, max_probes: int = 0):
+    """Batched membership + value lookup: ``(found, val_hi, val_lo)``.
+    Branchless lower-bound descent — log2(capacity) rounds of gathers,
+    no scatters (the shape ``ops/hashset.lookup`` used probe rounds for)."""
+    import jax.numpy as jnp
+
+    cap = ss.capacity
+    off = jnp.zeros(fp_hi.shape, jnp.int32)
+    step = cap
+    while step > 1:
+        step //= 2
+        mid = off + step
+        kh = ss.key_hi[mid - 1]
+        kl = ss.key_lo[mid - 1]
+        less = (kh < fp_hi) | ((kh == fp_hi) & (kl < fp_lo))
+        off = jnp.where((mid <= ss.n) & less, mid, off)
+    at = jnp.minimum(off, cap - 1)
+    hit = (off < ss.n) & (ss.key_hi[at] == fp_hi) & (ss.key_lo[at] == fp_lo)
+    vh = jnp.where(hit, ss.val_hi[at], jnp.uint32(0))
+    vl = jnp.where(hit, ss.val_lo[at], jnp.uint32(0))
+    return hit, vh, vl
+
+
+def grow(ss: SortedSet, new_capacity: int, xp) -> SortedSet:
+    """Capacity growth is a plain copy — no rehash (the sorted invariant
+    is capacity-independent, unlike hash slot assignment)."""
+    if new_capacity < ss.capacity:
+        raise ValueError("sorted set cannot shrink")
+    pad = new_capacity - ss.capacity
+    planes = [
+        xp.concatenate([p, xp.zeros((pad,), dtype=xp.uint32)])
+        for p in (ss.key_hi, ss.key_lo, ss.val_hi, ss.val_lo)
+    ]
+    return SortedSet(*planes, ss.n)

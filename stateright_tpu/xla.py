@@ -69,7 +69,7 @@ import numpy as np
 from .checker.base import Checker
 from .checker.path import Path
 from .core import Expectation, Model
-from .ops import fphash, hashset
+from .ops import fphash, hashset, sortedset
 
 
 #: The PackedModel protocol surface (module docstring above).
@@ -110,8 +110,13 @@ def capacity_hints(model: Model) -> Dict[str, int]:
     the first run's (shape, bucket) schedule (every grown capacity is a new
     array shape, i.e. a recompile; bench.py's warm/measured passes)."""
     out: Dict[str, int] = {}
-    if "_xla_table_cap_hint" in model.__dict__:
-        out["table_capacity"] = model.__dict__["_xla_table_cap_hint"]
+    table_hints = [
+        v
+        for k, v in model.__dict__.items()
+        if k.startswith("_xla_table_cap_hint_")
+    ]
+    if table_hints:
+        out["table_capacity"] = max(table_hints)
     if "_xla_frontier_cap_hint" in model.__dict__:
         out["frontier_capacity"] = model.__dict__["_xla_frontier_cap_hint"]
     return out
@@ -132,6 +137,7 @@ class XlaChecker(Checker):
         visit_cap: int = 4096,
         levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
+        dedup: str = "auto",
     ):
         import jax
 
@@ -157,6 +163,20 @@ class XlaChecker(Checker):
             if p.expectation == Expectation.EVENTUALLY:
                 self._ebit_of_prop[i] = len(self._ebit_of_prop)
         self._ebits0 = (1 << len(self._ebit_of_prop)) - 1
+
+        # Visited-set structure. The on-chip cost model (BASELINE.md) showed
+        # the scatter-election hash insert is the TPU bottleneck (0.24 M
+        # ins/s at 2^22) while sort runs at ~1.3 G keys/s, and that stream
+        # compaction inverts the same way (gather 3x over scatter) — so
+        # accelerators default to the sort-merge set + gather compaction
+        # (ops/sortedset.py) and CPUs keep the hash set + scatter compaction
+        # that wins there.
+        if dedup == "auto":
+            dedup = "hash" if jax.default_backend() == "cpu" else "sorted"
+        if dedup not in ("hash", "sorted"):
+            raise ValueError(f"dedup must be 'auto', 'hash', or 'sorted': {dedup!r}")
+        self._dedup = dedup
+        self._ds = sortedset if dedup == "sorted" else hashset
 
         self._max_probes = max_probes
         self._W = model.state_words
@@ -214,9 +234,10 @@ class XlaChecker(Checker):
         # Hints apply only when the caller took the defaults: an explicit
         # capacity — even a smaller one, e.g. to exercise the growth path —
         # must win over cross-checker state.
+        self._table_hint_key = f"_xla_table_cap_hint_{dedup}"
         if table_capacity is None:
             table_capacity = max(
-                1 << 20, model.__dict__.get("_xla_table_cap_hint", 0)
+                1 << 20, model.__dict__.get(self._table_hint_key, 0)
             )
         if frontier_capacity is None:
             frontier_capacity = max(
@@ -232,7 +253,7 @@ class XlaChecker(Checker):
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.
             self._frontier_capacity = max(frontier_capacity, 16)
-            self._table = hashset.make(table_capacity, jnp)
+            self._table = self._ds.make(table_capacity, jnp)
             self._restore(checkpoint)
             return
 
@@ -245,16 +266,12 @@ class XlaChecker(Checker):
         n_init = len(init_packed)
 
         self._frontier_capacity = max(frontier_capacity, 1 << max(n_init.bit_length(), 4))
-        self._table = hashset.make(table_capacity, jnp)
+        self._table = self._ds.make(table_capacity, jnp)
         # Insert init fingerprints with a zero parent (the "no predecessor"
-        # marker, like the None predecessor of bfs.rs:59-65). Tiny batch vs
-        # the full table: insert_auto takes the batch-proportional Pallas
-        # kernel on accelerators rather than the claim-buffer election.
-        from .ops.pallas_hashset import insert_auto
-
+        # marker, like the None predecessor of bfs.rs:59-65).
         dedup_init = self._dedup_words_host(init_packed)
         ihi, ilo = fphash.fingerprint_words(dedup_init, np)
-        self._table, is_new, ovf = insert_auto(
+        self._table, is_new, ovf = self._ds.insert(
             self._table,
             jnp.asarray(ihi),
             jnp.asarray(ilo),
@@ -264,7 +281,7 @@ class XlaChecker(Checker):
             max_probes=self._max_probes,
         )
         if bool(np.any(np.asarray(ovf))):  # pragma: no cover - tiny tables only
-            raise RuntimeError("hash table overflow while inserting init states")
+            raise RuntimeError("visited-set overflow while inserting init states")
         n_unique_init = int(np.sum(np.asarray(is_new)))
 
         self._frontier = self._pad_rows(init_packed, self._frontier_capacity)
@@ -300,21 +317,26 @@ class XlaChecker(Checker):
         cap = self._table.capacity
         while cap < 2 * n_entries:
             cap *= 2
-        self._table = hashset.make(cap, jnp)
-        while True:
-            table, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
-                self._table,
-                jnp.asarray(ck["key_hi"]),
-                jnp.asarray(ck["key_lo"]),
-                jnp.asarray(ck["val_hi"]),
-                jnp.asarray(ck["val_lo"]),
-                jnp.ones(n_entries, jnp.bool_),
-                max_probes=self._max_probes,
+        if self._dedup == "sorted":
+            self._table = sortedset.from_entries(
+                ck["key_hi"], ck["key_lo"], ck["val_hi"], ck["val_lo"], cap, jnp
             )
-            if not bool(np.any(np.asarray(ovf))):
-                self._table = table
-                break
-            self._table = hashset.make(self._table.capacity * 2, jnp)
+        else:
+            self._table = hashset.make(cap, jnp)
+            while True:
+                table, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
+                    self._table,
+                    jnp.asarray(ck["key_hi"]),
+                    jnp.asarray(ck["key_lo"]),
+                    jnp.asarray(ck["val_hi"]),
+                    jnp.asarray(ck["val_lo"]),
+                    jnp.ones(n_entries, jnp.bool_),
+                    max_probes=self._max_probes,
+                )
+                if not bool(np.any(np.asarray(ovf))):
+                    self._table = table
+                    break
+                self._table = hashset.make(self._table.capacity * 2, jnp)
 
         rows = np.asarray(ck["frontier"], dtype=np.uint32)
         n = len(rows)
@@ -389,13 +411,40 @@ class XlaChecker(Checker):
         def dedup_words(words):
             return model.packed_representative(words) if symmetry else words
 
+        ds = self._ds
+        gather_compact = self._dedup == "sorted"
+
         def compact(mask, cap, arrays):
             """Stream-compact rows where ``mask`` holds into ``cap``-row
             buffers (stable: original order preserved); rows beyond ``cap``
-            are routed to an out-of-range index and dropped. Returns
-            ``(compacted arrays, count)`` where ``count`` is the TOTAL mask
-            population — count > cap means truncation (the caller's
-            overflow signal)."""
+            are truncated. Returns ``(compacted arrays, count)`` where
+            ``count`` is the TOTAL mask population — count > cap means
+            truncation (the caller's overflow signal).
+
+            Two lowerings with identical results: cumsum + scatter (wins on
+            XLA:CPU) and stable argsort + gather (3x cheaper on TPU, where
+            XLA serializes the scatter — BASELINE.md cost model)."""
+            if gather_compact:
+                # cap may exceed the mask length (cand_cap = next_pow2 can
+                # round up past the grid; frontier caps can exceed cand
+                # caps for small action counts) — gather what exists, pad
+                # the rest with zeros.
+                take = min(cap, mask.shape[0])
+                order = jnp.argsort(~mask, stable=True)[:take]
+                smask = mask[order]
+                outs = []
+                for a in arrays:
+                    out = jnp.where(
+                        smask.reshape((take,) + (1,) * (a.ndim - 1)),
+                        a[order],
+                        jnp.zeros((), a.dtype),
+                    )
+                    if take < cap:
+                        out = jnp.concatenate(
+                            [out, jnp.zeros((cap - take,) + a.shape[1:], a.dtype)]
+                        )
+                    outs.append(out)
+                return outs, jnp.sum(mask, dtype=jnp.int32)
             pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
             idx = jnp.where(mask & (pos < cap), pos, cap)
             outs = [
@@ -483,8 +532,10 @@ class XlaChecker(Checker):
 
             # 4. dedup against the visited set. Compaction preserves lane
             #    order, so the insert's lowest-index winner election picks
-            #    the same candidate it would have picked uncompacted.
-            table, is_new, ovf = hashset.insert(
+            #    the same candidate it would have picked uncompacted. Both
+            #    structures share the same contract (is_new in batch order,
+            #    lowest-index winner, parent values stored).
+            table, is_new, ovf = ds.insert(
                 table, chi, clo, cpar_hi, cpar_lo, cvalid, max_probes=max_probes
             )
             step_unique = jnp.sum(is_new, dtype=jnp.int32)
@@ -712,7 +763,7 @@ class XlaChecker(Checker):
         import jax
 
         cand_cap = self._cand_cap_for(f_cap)
-        key = (f_cap, cand_cap, self._symmetry, self._max_probes)
+        key = (f_cap, cand_cap, self._symmetry, self._max_probes, self._dedup)
         fn = self._superstep_cache.get(key)
         if fn is None:
             fn = jax.jit(self._build_superstep(f_cap, cand_cap))
@@ -723,51 +774,64 @@ class XlaChecker(Checker):
         import jax
 
         cand_cap = self._cand_cap_for(f_cap)
-        key = ("fused", f_cap, cand_cap, self._symmetry, self._max_probes)
+        key = ("fused", f_cap, cand_cap, self._symmetry, self._max_probes, self._dedup)
         fn = self._superstep_cache.get(key)
         if fn is None:
             fn = jax.jit(self._build_fused(f_cap, cand_cap))
             self._superstep_cache[key] = fn
         return fn
 
-    #: Proactive-growth trigger: keep the open-addressing table at or below
-    #: this load factor. Probe-chain length (the dominant insert cost — see
-    #: BASELINE.md's cost model) grows superlinearly with load; growing at
-    #: 1/4 load bounds probe rounds at a 4x memory cost over the uniques.
+    #: Proactive-growth trigger for the HASH structure: keep the
+    #: open-addressing table at or below this load factor. Probe-chain
+    #: length (the dominant insert cost — see BASELINE.md's cost model)
+    #: grows superlinearly with load; growing at 1/4 load bounds probe
+    #: rounds at a 4x memory cost over the uniques.
     MAX_LOAD_NUM, MAX_LOAD_DEN = 1, 4
+    #: For the SORTED structure the trade inverts: per-level cost is the
+    #: sort of [capacity + candidates], so headroom costs sort bandwidth,
+    #: not probe rounds — run it denser and grow late.
+    SORTED_LOAD_NUM, SORTED_LOAD_DEN = 3, 4
 
     def _grow_table_if_loaded(self) -> None:
         """Double the table whenever the committed unique count crosses the
-        load ceiling — BEFORE inserts start paying long probe chains (the
-        reactive path only grows on probe-failure overflow, by which point
-        the load factor is far past the cheap regime)."""
-        while (
-            self._unique_count * self.MAX_LOAD_DEN
-            > self._table.capacity * self.MAX_LOAD_NUM
-        ):
+        structure's load ceiling — BEFORE inserts start paying (hash: long
+        probe chains; sorted: an overflow-retry round trip)."""
+        num, den = (
+            (self.SORTED_LOAD_NUM, self.SORTED_LOAD_DEN)
+            if self._dedup == "sorted"
+            else (self.MAX_LOAD_NUM, self.MAX_LOAD_DEN)
+        )
+        while self._unique_count * den > self._table.capacity * num:
             self._grow_table()
 
     def _grow_table(self) -> None:
-        """Rehash the visited set into a table of twice the capacity."""
+        """Double the visited-set capacity: a rehash for the hash table, a
+        plain plane copy for the sorted set (its invariant is
+        capacity-independent)."""
         import jax
         import jax.numpy as jnp
 
         old = self._table
-        occupied = (old.key_hi != 0) | (old.key_lo != 0)
-        bigger = hashset.make(old.capacity * 2, jnp)
-        bigger, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
-            bigger,
-            old.key_hi,
-            old.key_lo,
-            old.val_hi,
-            old.val_lo,
-            occupied,
-            max_probes=self._max_probes,
-        )
-        if bool(np.any(np.asarray(ovf))):  # pragma: no cover
-            raise RuntimeError("rehash overflow — pathological fingerprint distribution")
-        self._table = bigger
-        self._model.__dict__["_xla_table_cap_hint"] = bigger.capacity
+        if self._dedup == "sorted":
+            self._table = sortedset.grow(old, old.capacity * 2, jnp)
+        else:
+            occupied = (old.key_hi != 0) | (old.key_lo != 0)
+            bigger = hashset.make(old.capacity * 2, jnp)
+            bigger, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
+                bigger,
+                old.key_hi,
+                old.key_lo,
+                old.val_hi,
+                old.val_lo,
+                occupied,
+                max_probes=self._max_probes,
+            )
+            if bool(np.any(np.asarray(ovf))):  # pragma: no cover
+                raise RuntimeError(
+                    "rehash overflow — pathological fingerprint distribution"
+                )
+            self._table = bigger
+        self._model.__dict__[self._table_hint_key] = self._table.capacity
 
     def _raise_codec_overflow(self) -> None:
         raise RuntimeError(

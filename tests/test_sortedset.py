@@ -1,0 +1,185 @@
+"""The sort-merge visited set (ops/sortedset.py): op-level differential
+parity against the hash set, exact overflow semantics, and engine-level
+parity of ``spawn_xla(dedup="sorted")`` vs ``dedup="hash"``.
+
+The two structures implement the same contract (hashset.insert's
+docstring): is_new in original batch order, lowest-batch-index winner
+among in-batch duplicates, parent values stored for winners. The sorted
+set is the TPU-native lowering (BASELINE.md cost model: sort ~1.3 G
+keys/s on-chip vs 0.24 M ins/s for the scatter-election insert)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from stateright_tpu.ops import hashset, sortedset
+
+
+def _rand_batch(rng, m, universe):
+    hi = jnp.asarray(rng.integers(1, universe, m, dtype=np.uint32))
+    lo = jnp.asarray(rng.integers(1, universe, m, dtype=np.uint32))
+    vh = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+    vl = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+    act = jnp.asarray(rng.integers(0, 2, m).astype(bool))
+    return hi, lo, vh, vl, act
+
+
+@pytest.mark.parametrize("universe", [40, 2**31])  # heavy duplicates / near-unique
+def test_insert_lookup_differential_vs_hashset(universe):
+    rng = np.random.default_rng(11)
+    ss = sortedset.make(1 << 11, jnp)
+    hs = hashset.make(1 << 13, jnp)
+    for rnd in range(8):
+        hi, lo, vh, vl, act = _rand_batch(rng, 257, universe)
+        ss, s_new, s_ovf = sortedset.insert(ss, hi, lo, vh, vl, act)
+        hs, h_new, h_ovf = hashset.insert(hs, hi, lo, vh, vl, act)
+        assert np.array_equal(np.asarray(s_new), np.asarray(h_new)), rnd
+        assert not bool(s_ovf) and not bool(np.any(np.asarray(h_ovf)))
+        qh = jnp.asarray(rng.integers(1, min(universe + 20, 2**32 - 1), 128, dtype=np.uint32))
+        ql = jnp.asarray(rng.integers(1, min(universe + 20, 2**32 - 1), 128, dtype=np.uint32))
+        for a, b in zip(sortedset.lookup(ss, qh, ql), hashset.lookup(hs, qh, ql)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), rnd
+
+
+def test_sorted_invariant_and_grow():
+    rng = np.random.default_rng(3)
+    ss = sortedset.make(1 << 9, jnp)
+    hi, lo, vh, vl, act = _rand_batch(rng, 300, 2**20)
+    ss, _, _ = sortedset.insert(ss, hi, lo, vh, vl, act)
+    n = int(ss.n)
+    kh = np.asarray(ss.key_hi)
+    kl = np.asarray(ss.key_lo)
+    keys = (kh[:n].astype(np.uint64) << 32) | kl[:n]
+    assert np.all(keys[1:] > keys[:-1]), "occupied prefix must be strictly sorted"
+    assert not np.any(kh[n:]) and not np.any(kl[n:]), "pads must be zeros"
+
+    grown = sortedset.grow(ss, 1 << 11, jnp)
+    assert grown.capacity == 1 << 11 and int(grown.n) == n
+    found, gvh, gvl = sortedset.lookup(grown, jnp.asarray(kh[:n]), jnp.asarray(kl[:n]))
+    assert bool(jnp.all(found))
+    assert np.array_equal(np.asarray(gvh), np.asarray(ss.val_hi)[:n])
+    assert np.array_equal(np.asarray(gvl), np.asarray(ss.val_lo)[:n])
+
+
+def test_exact_overflow_flag():
+    """Unlike the hash set's probe-budget overflow, the sorted set reports
+    overflow exactly when merged uniques exceed capacity."""
+    ss = sortedset.make(16, jnp)
+    m = 24
+    hi = jnp.arange(1, m + 1, dtype=jnp.uint32)
+    lo = jnp.ones((m,), jnp.uint32)
+    z = jnp.zeros((m,), jnp.uint32)
+    act = jnp.ones((m,), bool)
+    _, _, ovf = sortedset.insert(ss, hi, lo, z, z, act)
+    assert bool(ovf)
+    _, _, ovf16 = sortedset.insert(ss, hi[:16], lo[:16], z[:16], z[:16], act[:16])
+    assert not bool(ovf16)  # exactly at capacity: fits
+
+
+def test_winner_is_lowest_batch_index():
+    ss = sortedset.make(16, jnp)
+    hi = jnp.asarray([5, 5, 5], dtype=jnp.uint32)
+    lo = jnp.asarray([9, 9, 9], dtype=jnp.uint32)
+    vh = jnp.asarray([100, 200, 300], dtype=jnp.uint32)
+    vl = jnp.zeros((3,), jnp.uint32)
+    ss, is_new, _ = sortedset.insert(ss, hi, lo, vh, vl, jnp.ones((3,), bool))
+    assert np.asarray(is_new).tolist() == [True, False, False]
+    found, got_vh, _ = sortedset.lookup(ss, hi[:1], lo[:1])
+    assert bool(found[0]) and int(got_vh[0]) == 100  # winner's value stored
+
+
+def test_from_entries_roundtrip():
+    rng = np.random.default_rng(5)
+    n = 100
+    kh = rng.permutation(np.arange(1, n + 1, dtype=np.uint32))
+    kl = rng.integers(1, 2**32, n, dtype=np.uint32)
+    vh = rng.integers(0, 2**32, n, dtype=np.uint32)
+    vl = rng.integers(0, 2**32, n, dtype=np.uint32)
+    ss = sortedset.from_entries(kh, kl, vh, vl, 128, jnp)
+    found, got_vh, got_vl = sortedset.lookup(ss, jnp.asarray(kh), jnp.asarray(kl))
+    assert bool(jnp.all(found))
+    assert np.array_equal(np.asarray(got_vh), vh)
+    assert np.array_equal(np.asarray(got_vl), vl)
+
+
+# --- engine-level parity ----------------------------------------------------
+
+
+def _counts(c):
+    return c.state_count(), c.unique_state_count(), c.max_depth()
+
+
+def test_engine_parity_two_phase_commit():
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="hash").join()
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="sorted").join()
+    assert _counts(a) == _counts(b) == (1146, 288, 11)
+    assert set(a.discoveries()) == set(b.discoveries())
+
+
+def test_gather_compact_cap_exceeds_mask_length():
+    """Regression: the gather-compact lowering must handle compaction caps
+    larger than the source array (cand_cap = next_pow2 rounding past the
+    grid; frontier caps above cand caps for small action counts)."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    c = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(dedup="sorted", table_capacity=1 << 8, frontier_capacity=1 << 5)
+        .join()
+    )
+    assert _counts(c) == (1146, 288, 11)
+
+
+def test_engine_parity_under_forced_growth():
+    """Tiny capacities force the overflow-retry + growth path of both
+    structures (sorted growth = plane copy, hash growth = rehash)."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    kw = dict(table_capacity=1 << 8, frontier_capacity=1 << 6)
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="hash", **kw).join()
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="sorted", **kw).join()
+    assert _counts(a) == _counts(b) == (1146, 288, 11)
+
+
+def test_engine_parity_discovery_model():
+    """A model with a real counterexample: discovery names and witness
+    paths must agree across dedup structures."""
+    from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
+
+    a = PackedSingleCopyRegister(2, 2).checker().spawn_xla(dedup="hash").join()
+    b = PackedSingleCopyRegister(2, 2).checker().spawn_xla(dedup="sorted").join()
+    da, db = a.discoveries(), b.discoveries()
+    assert set(da) == set(db) and da
+    for name in da:
+        assert len(da[name]) == len(db[name])
+
+
+def test_engine_parity_symmetry():
+    from stateright_tpu.models.increment import PackedIncrement
+
+    a = PackedIncrement(3).checker().symmetry().spawn_xla(dedup="hash").join()
+    b = PackedIncrement(3).checker().symmetry().spawn_xla(dedup="sorted").join()
+    assert _counts(a) == _counts(b)
+
+
+def test_checkpoint_crosses_dedup_structures(tmp_path):
+    """A checkpoint written by a hash-table run restores into a sorted-set
+    run (and vice versa): the snapshot format is structure-independent."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    path = str(tmp_path / "ck.npz")
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(
+        dedup="hash", levels_per_dispatch=1
+    )
+    for _ in range(4):
+        a._run_block()
+    a.save_checkpoint(path)
+    resumed = PackedTwoPhaseSys(3).checker().spawn_xla(
+        dedup="sorted", checkpoint=path
+    ).join()
+    full = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="sorted").join()
+    assert _counts(resumed) == _counts(full) == (1146, 288, 11)

@@ -284,13 +284,13 @@ def test_learned_capacities_apply_to_defaults_only():
     a = model.checker().spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 8)
     a.join()
     assert a._table.capacity > (1 << 8)  # 1,568 uniques forced growth
-    assert model.__dict__["_xla_table_cap_hint"] == a._table.capacity
+    assert model.__dict__["_xla_table_cap_hint_hash"] == a._table.capacity
     # Explicit small capacity is honored verbatim despite the hint.
     b = model.checker().spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 8)
     assert b._table.capacity == 1 << 8
     b.join()
     assert b.unique_state_count() == a.unique_state_count()
     # Default capacities pick the hint up when it exceeds them.
-    model.__dict__["_xla_table_cap_hint"] = 1 << 21
+    model.__dict__["_xla_table_cap_hint_hash"] = 1 << 21
     c = model.checker().spawn_xla(frontier_capacity=1 << 10)
     assert c._table.capacity == 1 << 21

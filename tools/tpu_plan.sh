@@ -28,26 +28,8 @@ python bench.py
 log "stage 3: microbench (results -> tpu_microbench.log)"
 timeout 1800 python tools/microbench.py 6 2>&1 | tee tpu_microbench.log
 
-log "stage 4: compiled Pallas insert probe"
-timeout 600 python - <<'EOF' 2>&1 | tee tpu_pallas.log
-import numpy as np
-import jax, jax.numpy as jnp
-from stateright_tpu.ops import hashset
-from stateright_tpu.ops.pallas_hashset import insert_pallas
-hs = hashset.make(1 << 16, jnp)
-rng = np.random.default_rng(0)
-m = 256
-hi = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
-lo = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
-act = jnp.ones((m,), bool)
-try:
-    hs2, is_new, ovf = insert_pallas(hs, hi, lo, hi, lo, act, interpret=False)
-    ref, ref_new, ref_ovf = hashset.insert(hs, hi, lo, hi, lo, act)
-    ok = bool(jnp.all(is_new == ref_new)) and not bool(jnp.any(ovf))
-    print("pallas compiled insert:", "MATCHES XLA insert" if ok else "DIVERGES")
-except Exception as e:
-    print(f"pallas compiled insert FAILED to lower/run: {type(e).__name__}: {e}")
-EOF
+# (stage 4, the compiled-Pallas insert probe, ran 2026-07-31 and the kernel
+# failed to lower — tpu_pallas.log; kernel removed per the keep-or-kill rule.)
 
 log "stage 5: device-scale soak (results -> tpu_soak.log)"
 # Two runs per config: full-coverage counts must be stable run-to-run.
