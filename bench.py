@@ -221,7 +221,12 @@ def _worker(platform: str) -> None:
 
     rm = int(os.environ.get("BENCH_RM", "8"))
     frontier_pow = int(os.environ.get("BENCH_FRONTIER_POW", "19"))
-    table_pow = int(os.environ.get("BENCH_TABLE_POW", "24"))
+    # Sorted-dedup (the accelerator default) pays one [capacity + batch]
+    # sort per level, so oversizing the table costs every level: 2^22 holds
+    # rm=8's 1.74M uniques within the 3/4-load growth rule with no growth
+    # recompiles. (The round-2 hash default was 2^24 — probe chains want
+    # headroom; capacity was nearly free there.)
+    table_pow = int(os.environ.get("BENCH_TABLE_POW", "22"))
     if platform == "cpu":
         rm = min(rm, int(os.environ.get("BENCH_CPU_RM", "7")))
         frontier_pow = min(frontier_pow, 17)

@@ -47,7 +47,7 @@ class DeviceOnDemandChecker(XlaChecker):
         # self._depth is 1 for a fresh init frontier and the restored depth
         # after a checkpoint resume — the pool must inherit it either way.
         self._pool_add(
-            np.asarray(self._frontier)[: self._frontier_count],
+            self._frontier_rows_host(),
             np.asarray(self._frontier_ebits)[: self._frontier_count],
             self._depth,
         )
@@ -136,7 +136,7 @@ class DeviceOnDemandChecker(XlaChecker):
         need = 1 << max(int(len(rows) - 1).bit_length(), 4)
         if need > self._frontier_capacity:
             self._frontier_capacity = need
-        self._frontier = jnp.asarray(rows)
+        self._store_frontier_rows(rows)
         self._frontier_ebits = jnp.asarray(ebits)
         self._frontier_count = len(rows)
         self._depth = depth
@@ -151,14 +151,14 @@ class DeviceOnDemandChecker(XlaChecker):
 
         self._depth = depth
         self._exhausted = False
-        self._frontier = jnp.asarray(rows)
+        self._store_frontier_rows(rows)
         self._frontier_ebits = jnp.asarray(ebits)
         self._frontier_count = len(rows)
         self._run_block_single()
         # Children are table-fresh by construction, so they cannot collide
         # with an existing pending entry.
         self._pool_add(
-            np.asarray(self._frontier)[: self._frontier_count],
+            self._frontier_rows_host(),
             np.asarray(self._frontier_ebits)[: self._frontier_count],
             depth + 1,
         )

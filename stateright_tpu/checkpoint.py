@@ -116,7 +116,7 @@ def _live_frontier(checker):
         )
     n = checker._frontier_count
     return (
-        np.asarray(checker._frontier)[:n],
+        checker._frontier_rows_host(),
         np.asarray(checker._frontier_ebits)[:n],
     )
 

@@ -51,6 +51,31 @@ def _fmix32(h, xp):
     return h
 
 
+def _overflow_ok(xp):
+    """numpy warns on (intended, wrapping) uint32 overflow; jnp does not."""
+    import contextlib
+
+    import numpy as _np
+
+    return contextlib.nullcontext() if xp is not _np else _np.errstate(over="ignore")
+
+
+def _finalize(fold_hi, fold_lo, xp):
+    """Seeded avalanche over the per-word fold, plus the reserved-pair
+    remap: (0, 0) is the EMPTY sentinel of both visited-set layouts and
+    (0xFFFFFFFF, 0xFFFFFFFF) the sorted set's in-sort pad key. One
+    implementation — the contract is load-bearing and differentially
+    tested against the C++ mirror."""
+    u = xp.uint32
+    hi = _fmix32(fold_hi ^ u(_SEED_HI), xp)
+    lo = _fmix32(fold_lo ^ u(_SEED_LO), xp)
+    is_empty = (hi == u(0)) & (lo == u(0))
+    lo = xp.where(is_empty, u(1), lo)
+    is_full = (hi == u(0xFFFFFFFF)) & (lo == u(0xFFFFFFFF))
+    lo = xp.where(is_full, u(0xFFFFFFFE), lo)
+    return hi, lo
+
+
 def fingerprint_words(words, xp):
     """Fingerprint packed states: ``[..., W] uint32 -> ([...], [...])``
     (hi, lo) uint32 lanes.
@@ -58,14 +83,9 @@ def fingerprint_words(words, xp):
     ``xp`` is the array namespace: ``numpy`` on host, ``jax.numpy`` under
     jit.  Both produce identical bits.
     """
-    import contextlib
-
     import numpy as _np
 
-    # numpy warns on (intended, wrapping) uint32 overflow; jnp does not.
-    under_jax = xp is not _np
-    ctx = contextlib.nullcontext() if under_jax else _np.errstate(over="ignore")
-    with ctx:
+    with _overflow_ok(xp):
         u = xp.uint32
         w_count = words.shape[-1]
         idx = _np.arange(1, w_count + 1, dtype=_np.uint64)
@@ -82,16 +102,31 @@ def fingerprint_words(words, xp):
         for i in range(1, w_count):
             fold_hi = fold_hi ^ m_hi[..., i]
             fold_lo = fold_lo ^ m_lo[..., i]
-        # ...then one avalanche over the seeded fold.
-        hi = _fmix32(fold_hi ^ u(_SEED_HI), xp)
-        lo = _fmix32(fold_lo ^ u(_SEED_LO), xp)
-        # Reserve (0, 0) (the EMPTY sentinel of both visited-set layouts)
-        # and (0xFFFFFFFF, 0xFFFFFFFF) (the sorted set's in-sort pad key).
-        is_empty = (hi == u(0)) & (lo == u(0))
-        lo = xp.where(is_empty, u(1), lo)
-        is_full = (hi == u(0xFFFFFFFF)) & (lo == u(0xFFFFFFFF))
-        lo = xp.where(is_full, u(0xFFFFFFFE), lo)
-        return hi, lo
+        # ...then one avalanche + reserved-pair remap.
+        return _finalize(fold_hi, fold_lo, xp)
+
+
+def fingerprint_planes(planes, xp):
+    """``fingerprint_words`` over plane-major state buffers: ``planes`` is a
+    ``[W, ...]`` array (or a W-sequence of same-shape arrays), one plane per
+    packed word.  Bit-identical to ``fingerprint_words(words)`` where
+    ``words[..., w] == planes[w]`` — the engine's structure-of-arrays layout
+    keeps state words in separate lanes because XLA:TPU tiles the minor two
+    dims to (8, 128): a ``[N, W]`` row buffer with W=2 pads 2 lanes to 128,
+    a ~64x memory-traffic blowup on every elementwise op and gather.
+    """
+    with _overflow_ok(xp):
+        u = xp.uint32
+        fold_hi = fold_lo = None
+        for i in range(len(planes)):
+            pos_hi = u((0x9E3779B9 * (i + 1)) & 0xFFFFFFFF)
+            pos_lo = u((0x61C88647 * (i + 1)) & 0xFFFFFFFF)
+            w = planes[i].astype(xp.uint32)
+            m_hi = _fmix32(w * u(_WORD_MIX_HI) + pos_hi, xp)
+            m_lo = _fmix32(w * u(_WORD_MIX_LO) + pos_lo, xp)
+            fold_hi = m_hi if fold_hi is None else fold_hi ^ m_hi
+            fold_lo = m_lo if fold_lo is None else fold_lo ^ m_lo
+        return _finalize(fold_hi, fold_lo, xp)
 
 
 def fingerprint_u64(words, xp) -> "int | object":

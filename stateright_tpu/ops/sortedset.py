@@ -107,7 +107,6 @@ def insert(
 
     cap = ss.capacity
     m = fp_hi.shape[0]
-    total = cap + m
     full = jnp.uint32(0xFFFFFFFF)
 
     # Pad rows (unoccupied visited slots, inactive candidates) get the
@@ -115,17 +114,18 @@ def insert(
     vis_valid = jnp.arange(cap) < ss.n
     kh = jnp.concatenate([jnp.where(vis_valid, ss.key_hi, full), jnp.where(active, fp_hi, full)])
     kl = jnp.concatenate([jnp.where(vis_valid, ss.key_lo, full), jnp.where(active, fp_lo, full)])
-    # Tie-break ticket: visited rows carry 0 so they sort ahead of any
-    # equal-key candidate; candidates carry 1 + original index, making the
-    # sort key triple unique (visited keys are unique by invariant) and
-    # the whole pipeline deterministic by construction.
-    ticket = jnp.concatenate(
-        [jnp.zeros((cap,), jnp.int32), 1 + jnp.arange(m, dtype=jnp.int32)]
-    )
-    vh = jnp.concatenate([ss.val_hi, val_hi])
-    vl = jnp.concatenate([ss.val_lo, val_lo])
+    # Tie-break ticket = position in the concatenated input: visited row i
+    # carries i (< cap), candidate i carries cap + i — so visited rows sort
+    # ahead of any equal-key candidate and in-batch duplicates resolve to
+    # the lowest original index, making the key triple unique (visited keys
+    # are unique by invariant) and the pipeline deterministic by
+    # construction. The ticket doubles as the gather index that recovers
+    # values AFTER the sort: values ride one gather each instead of two
+    # extra sort operands (a sort operand is ~log^2 n data passes, a gather
+    # is one).
+    ticket = jnp.arange(cap + m, dtype=jnp.int32)
 
-    skh, skl, st, svh, svl = jax.lax.sort((kh, kl, ticket, vh, vl), num_keys=3)
+    skh, skl, st = jax.lax.sort((kh, kl, ticket), num_keys=3)
 
     run_start = jnp.concatenate(
         [
@@ -134,7 +134,7 @@ def insert(
         ]
     )
     real = ~((skh == full) & (skl == full))
-    is_cand = st > 0
+    is_cand = st >= cap
     winner = run_start & is_cand & real  # run has no visited row, lowest ticket
     keep = real & (winner | ~is_cand)  # surviving = old rows + new winners
     new_n = jnp.sum(keep, dtype=jnp.int32)
@@ -146,12 +146,16 @@ def insert(
     z = jnp.uint32(0)
     nkh = jnp.where(row_ok, skh[order], z)
     nkl = jnp.where(row_ok, skl[order], z)
-    nvh = jnp.where(row_ok, svh[order], z)
-    nvl = jnp.where(row_ok, svl[order], z)
+    # Values of surviving rows, via their pre-sort position.
+    vh = jnp.concatenate([ss.val_hi, val_hi])
+    vl = jnp.concatenate([ss.val_lo, val_lo])
+    src = st[order]
+    nvh = jnp.where(row_ok, vh[src], z)
+    nvl = jnp.where(row_ok, vl[src], z)
 
     # Route is_new back to original batch order. Winner tickets are unique,
     # so the scatter is conflict-free; non-winners are routed out of range.
-    idx = jnp.where(winner, st - 1, m)
+    idx = jnp.where(winner, st - cap, m)
     is_new = jnp.zeros((m,), jnp.bool_).at[idx].set(True, mode="drop")
 
     return SortedSet(nkh, nkl, nvh, nvl, jnp.minimum(new_n, cap)), is_new, overflow

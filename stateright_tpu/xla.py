@@ -177,6 +177,15 @@ class XlaChecker(Checker):
             raise ValueError(f"dedup must be 'auto', 'hash', or 'sorted': {dedup!r}")
         self._dedup = dedup
         self._ds = sortedset if dedup == "sorted" else hashset
+        # Structure-of-arrays state layout rides with the sorted (accelerator)
+        # structure: XLA:TPU tiles the minor two dims of every buffer to
+        # (8, 128), so a [N, W] row-major frontier with W=2 pads 2 lanes to
+        # 128 — a ~64x memory-traffic blowup on every elementwise op and
+        # gather over packed states. Plane-major [W, N] buffers keep N on
+        # the 128-lane axis. The planes superstep preserves the rows
+        # superstep's semantics bit-for-bit (candidates are restored to
+        # state-major order before the insert's winner election).
+        self._soa = dedup == "sorted"
 
         self._max_probes = max_probes
         self._W = model.state_words
@@ -376,6 +385,18 @@ class XlaChecker(Checker):
         out[: len(rows)] = rows
         return jnp.asarray(out)
 
+    def _frontier_rows_host(self) -> np.ndarray:
+        """The live frontier as host-side ``[n, W]`` rows (checkpointing,
+        visitors, and the on-demand pool consume rows)."""
+        return np.asarray(self._frontier)[: self._frontier_count]
+
+    def _store_frontier_rows(self, rows: np.ndarray) -> None:
+        """Replace the device frontier with these host rows; the caller
+        maintains ``_frontier_count``/capacity."""
+        import jax.numpy as jnp
+
+        self._frontier = jnp.asarray(np.asarray(rows, dtype=np.uint32))
+
     def _dedup_words_host(self, rows: np.ndarray) -> np.ndarray:
         """Host-side dedup-key transform: representative packing when
         symmetry is on (the packed analogue of dfs.rs:357-362)."""
@@ -396,16 +417,91 @@ class XlaChecker(Checker):
     # --- the fused super-step ---------------------------------------------
 
     def _build_superstep(self, f_cap: int, cand_cap: int):
+        if self._soa:
+            return self._build_superstep_planes(f_cap, cand_cap)
+        return self._build_superstep_rows(f_cap, cand_cap)
+
+    def _checking_blocks(self):
+        """The checking semantics shared verbatim by the rows and planes
+        supersteps: fused property evaluation (with host-verified candidate
+        collection injected as ``hv_compact``) and terminal detection for
+        eventually counterexamples (bfs.rs:279-325, 374-381). One
+        implementation so the two layout engines cannot drift."""
+        prop_specs = [(i, p.expectation) for i, p in enumerate(self._properties)]
+        ebit_of_prop = dict(self._ebit_of_prop)
+        hv_idx = list(self._hv_idx)
+        hv_cap = self._hv_cap
+        W = self._W
+
+        def pin(viol, fhi, flo, i, disc_found, disc_fp, jnp):
+            """First-witness election for property ``i`` (races in the
+            reference are benign, bfs.rs:291-306; here 'first' is exact)."""
+            has = jnp.any(viol)
+            first = jnp.argmax(viol)
+            take = has & ~disc_found[i]
+            disc_fp = disc_fp.at[i, 0].set(jnp.where(take, fhi[first], disc_fp[i, 0]))
+            disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
+            disc_found = disc_found.at[i].set(disc_found[i] | has)
+            return disc_found, disc_fp
+
+        def eval_properties(
+            props, f_valid, f_ebits, fhi, flo, disc_found, disc_fp, hv_compact, jnp
+        ):
+            hv_words_out = []
+            hv_fp_out = []
+            hv_count_out = []
+            for i, expectation in prop_specs:
+                if expectation == Expectation.EVENTUALLY:
+                    bit = jnp.uint32(1 << ebit_of_prop[i])
+                    sat = props[:, i] & f_valid
+                    f_ebits = jnp.where(sat, f_ebits & ~bit, f_ebits)
+                    continue
+                if expectation == Expectation.ALWAYS:
+                    viol = ~props[:, i] & f_valid
+                else:  # SOMETIMES: an example is a "discovery" too
+                    viol = props[:, i] & f_valid
+                if i in hv_idx:
+                    # Candidates only — the host confirms with the exact
+                    # condition before anything becomes a discovery.
+                    cw, cf, n_viol = hv_compact(viol)
+                    hv_words_out.append(cw)
+                    hv_fp_out.append(cf)
+                    hv_count_out.append(n_viol)
+                    continue
+                disc_found, disc_fp = pin(viol, fhi, flo, i, disc_found, disc_fp, jnp)
+            if hv_idx:
+                hv = (
+                    jnp.stack(hv_words_out),
+                    jnp.stack(hv_fp_out),
+                    jnp.stack(hv_count_out),
+                )
+            else:
+                hv = (
+                    jnp.zeros((0, hv_cap, W), jnp.uint32),
+                    jnp.zeros((0, hv_cap, 2), jnp.uint32),
+                    jnp.zeros((0,), jnp.int32),
+                )
+            return f_ebits, disc_found, disc_fp, hv
+
+        def terminal_pass(terminal, f_ebits, fhi, flo, disc_found, disc_fp, jnp):
+            for i, expectation in prop_specs:
+                if expectation != Expectation.EVENTUALLY:
+                    continue
+                bit = jnp.uint32(1 << ebit_of_prop[i])
+                viol = terminal & ((f_ebits & bit) != 0)
+                disc_found, disc_fp = pin(viol, fhi, flo, i, disc_found, disc_fp, jnp)
+            return disc_found, disc_fp
+
+        return eval_properties, terminal_pass
+
+    def _build_superstep_rows(self, f_cap: int, cand_cap: int):
         import jax
         import jax.numpy as jnp
 
         model = self._model
-        prop_specs = [(i, p.expectation) for i, p in enumerate(self._properties)]
-        ebit_of_prop = dict(self._ebit_of_prop)
         symmetry = self._symmetry
         A, W = self._A, self._W
         max_probes = self._max_probes
-        hv_idx = list(self._hv_idx)
         hv_cap = self._hv_cap
 
         def dedup_words(words):
@@ -453,6 +549,17 @@ class XlaChecker(Checker):
             ]
             return outs, jnp.sum(mask, dtype=jnp.int32)
 
+        eval_properties, terminal_pass = self._checking_blocks()
+
+        def hv_compact_rows(frontier, fhi, flo):
+            def hv_compact(viol):
+                (cw, cf), n_viol = compact(
+                    viol, hv_cap, [frontier, jnp.stack([fhi, flo], axis=1)]
+                )
+                return cw, cf, n_viol
+
+            return hv_compact
+
         def superstep(frontier, f_ebits, f_count, table, disc_found, disc_fp):
             f_valid = jnp.arange(f_cap) < f_count
             dw = jax.vmap(dedup_words)(frontier)
@@ -460,43 +567,12 @@ class XlaChecker(Checker):
 
             # 1. fused property evaluation over the frontier.
             props = jax.vmap(model.packed_properties)(frontier)  # [F, P]
-            hv_words_out = []
-            hv_fp_out = []
-            hv_count_out = []
-            for i, expectation in prop_specs:
-                if expectation == Expectation.EVENTUALLY:
-                    bit = jnp.uint32(1 << ebit_of_prop[i])
-                    sat = props[:, i] & f_valid
-                    f_ebits = jnp.where(sat, f_ebits & ~bit, f_ebits)
-                    continue
-                if expectation == Expectation.ALWAYS:
-                    viol = ~props[:, i] & f_valid
-                else:  # SOMETIMES: an example is a "discovery" too
-                    viol = props[:, i] & f_valid
-                if i in hv_idx:
-                    # Candidates only — the host confirms with the exact
-                    # condition before anything becomes a discovery.
-                    (cw, cf), n_viol = compact(
-                        viol, hv_cap, [frontier, jnp.stack([fhi, flo], axis=1)]
-                    )
-                    hv_words_out.append(cw)
-                    hv_fp_out.append(cf)
-                    hv_count_out.append(n_viol)
-                    continue
-                has = jnp.any(viol)
-                first = jnp.argmax(viol)
-                take = has & ~disc_found[i]
-                disc_fp = disc_fp.at[i, 0].set(jnp.where(take, fhi[first], disc_fp[i, 0]))
-                disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
-                disc_found = disc_found.at[i].set(disc_found[i] | has)
-            if hv_idx:
-                hv_words = jnp.stack(hv_words_out)
-                hv_fps = jnp.stack(hv_fp_out)
-                hv_counts = jnp.stack(hv_count_out)
-            else:
-                hv_words = jnp.zeros((0, hv_cap, W), jnp.uint32)
-                hv_fps = jnp.zeros((0, hv_cap, 2), jnp.uint32)
-                hv_counts = jnp.zeros((0,), jnp.int32)
+            f_ebits, disc_found, disc_fp, (hv_words, hv_fps, hv_counts) = (
+                eval_properties(
+                    props, f_valid, f_ebits, fhi, flo, disc_found, disc_fp,
+                    hv_compact_rows(frontier, fhi, flo), jnp,
+                )
+            )
 
             # 2. full action-grid expansion. A model may return a third
             #    per-action overflow mask: "this successor exists but does
@@ -544,17 +620,9 @@ class XlaChecker(Checker):
             # 5. terminal detection for eventually counterexamples
             #    (bfs.rs:374-381; duplicates count as successors).
             terminal = f_valid & ~jnp.any(valid, axis=1)
-            for i, expectation in prop_specs:
-                if expectation != Expectation.EVENTUALLY:
-                    continue
-                bit = jnp.uint32(1 << ebit_of_prop[i])
-                viol = terminal & ((f_ebits & bit) != 0)
-                has = jnp.any(viol)
-                first = jnp.argmax(viol)
-                take = has & ~disc_found[i]
-                disc_fp = disc_fp.at[i, 0].set(jnp.where(take, fhi[first], disc_fp[i, 0]))
-                disc_fp = disc_fp.at[i, 1].set(jnp.where(take, flo[first], disc_fp[i, 1]))
-                disc_found = disc_found.at[i].set(disc_found[i] | has)
+            disc_found, disc_fp = terminal_pass(
+                terminal, f_ebits, fhi, flo, disc_found, disc_fp, jnp
+            )
 
             # 6. stream-compact survivors into the next frontier.
             (new_frontier, new_ebits), new_count = compact(
@@ -581,6 +649,189 @@ class XlaChecker(Checker):
             )
 
         return superstep
+
+    def _build_superstep_planes(self, f_cap: int, cand_cap: int):
+        """The superstep with plane-major (structure-of-arrays) bulk
+        buffers: the action grid and the candidate set live as ``[W, M]``
+        planes so every sort, gather, and elementwise pass over them runs
+        on 128-lane-friendly 1-D arrays (see the layout note in
+        ``__init__``).  The frontier itself stays ``[F, W]`` rows: it is
+        the kernel-facing boundary (vmapped model kernels take ``[W]``
+        rows) and two engine-measured facts pin this shape — (a) frontier
+        buffers are a factor A*W smaller than the grid, so their layout is
+        off the critical path, and (b) XLA:CPU (jax 0.9.0) MIScompiles a
+        transpose fused INTO a vmapped kernel (a scalar-cond ``jnp.where``
+        inside the kernel returns the wrong branch for batches >= 64;
+        eager and jit disagree) — rows-in/transpose-out is the safe fusion
+        direction, planes-in/vmap is not.
+
+        Semantics are bit-identical to the rows superstep: the grid
+        flattens a-major (``j = a*F + f``, the tiling-friendly order) and
+        the candidate compaction sorts by the state-major rank ``f*A + a``,
+        so the insert's lowest-index winner election, the stored parents,
+        and the next frontier's order all match the rows engine (and the
+        host oracle's "for each state, for each action" enumeration)
+        exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self._model
+        symmetry = self._symmetry
+        A, W = self._A, self._W
+        max_probes = self._max_probes
+        hv_cap = self._hv_cap
+        ds = self._ds
+
+        def dedup_words(words):
+            return model.packed_representative(words) if symmetry else words
+
+        def step3(words):
+            out = model.packed_step(words)
+            if len(out) == 3:
+                return out
+            nxt, valid = out
+            return nxt, valid, jnp.zeros_like(valid)
+
+        def compact_1d(mask, cap, arrays, prio=None, rows_out=()):
+            """Stream-compact lanes where ``mask`` holds into ``cap`` slots.
+            ``arrays`` are 1-D lanes or [W, M] planes (compacted along M);
+            indices in ``rows_out`` mark plane entries to emit as [cap, W]
+            rows instead (the kernel/host-facing shape; the gather is by
+            plane either way, only the final stack differs). With ``prio``
+            survivors come out in ascending prio order (the semantic-order
+            restoration); otherwise stable in array order."""
+            m = mask.shape[0]
+            iota = jnp.arange(m, dtype=jnp.int32)
+            if prio is None:
+                order = jnp.argsort(~mask, stable=True)
+            else:
+                _, _, order = jax.lax.sort(
+                    ((~mask).astype(jnp.int32), prio, iota), num_keys=2
+                )
+            take = min(cap, m)
+            order = order[:take]
+            smask = mask[order]
+            z32 = jnp.uint32(0)
+            outs = []
+            for pos, a in enumerate(arrays):
+                if a.ndim == 1:
+                    out = jnp.where(smask, a[order], jnp.zeros((), a.dtype))
+                    if take < cap:
+                        out = jnp.concatenate([out, jnp.zeros((cap - take,), a.dtype)])
+                elif pos in rows_out:
+                    planes = [jnp.where(smask, a[w][order], z32) for w in range(a.shape[0])]
+                    out = jnp.stack(planes, axis=1)  # [take, W] rows
+                    if take < cap:
+                        out = jnp.concatenate(
+                            [out, jnp.zeros((cap - take, a.shape[0]), a.dtype)]
+                        )
+                else:
+                    out = jnp.where(smask[None, :], a[:, order], jnp.zeros((), a.dtype))
+                    if take < cap:
+                        out = jnp.concatenate(
+                            [out, jnp.zeros((a.shape[0], cap - take), a.dtype)], axis=1
+                        )
+                outs.append(out)
+            return outs, jnp.sum(mask, dtype=jnp.int32)
+
+        eval_properties, terminal_pass = self._checking_blocks()
+
+        def hv_compact_planes(frontier, fhi, flo):
+            def hv_compact(viol):
+                (cw, cfh, cfl), n_viol = compact_1d(
+                    viol, hv_cap, [frontier.T, fhi, flo], rows_out=(0,)
+                )
+                return cw, jnp.stack([cfh, cfl], axis=1), n_viol
+
+            return hv_compact
+
+        def superstep(frontier, f_ebits, f_count, table, disc_found, disc_fp):
+            # frontier: [F, W] rows (kernel-facing boundary).
+            f_valid = jnp.arange(f_cap) < f_count
+            dw = jax.vmap(dedup_words)(frontier)
+            fhi, flo = fphash.fingerprint_words(dw, jnp)
+
+            # 1. fused property evaluation over the frontier.
+            props = jax.vmap(model.packed_properties)(frontier)  # [F, P]
+            f_ebits, disc_found, disc_fp, (hv_words, hv_fps, hv_counts) = (
+                eval_properties(
+                    props, f_valid, f_ebits, fhi, flo, disc_found, disc_fp,
+                    hv_compact_planes(frontier, fhi, flo), jnp,
+                )
+            )
+
+            # 2. action-grid expansion ([F, A, W] from the standard vmap;
+            #    codec overflow folded in as in rows mode).
+            nxt, valid, step_ovf = jax.vmap(step3)(frontier)
+            codec_overflow = jnp.any(step_ovf & f_valid[:, None])
+            valid = valid & f_valid[:, None]
+            step_states = jnp.sum(valid, dtype=jnp.int32)
+
+            # 3. flatten a-major into [W, A*F] planes (F stays on the
+            #    128-lane axis; this transpose is what XLA materializes)
+            #    and compact in state-major rank order.
+            grid = jnp.transpose(nxt, (2, 1, 0)).reshape(W, A * f_cap)
+            vmask = valid.T.reshape(A * f_cap)
+            par_hi = jnp.broadcast_to(fhi[None, :], (A, f_cap)).reshape(-1)
+            par_lo = jnp.broadcast_to(flo[None, :], (A, f_cap)).reshape(-1)
+            child_ebits = jnp.broadcast_to(f_ebits[None, :], (A, f_cap)).reshape(-1)
+            j = jnp.arange(A * f_cap, dtype=jnp.int32)
+            prio = (j % f_cap) * A + (j // f_cap)  # semantic rank f*A + a
+            (ccand, cpar_hi, cpar_lo, cebits), n_valid = compact_1d(
+                vmask, cand_cap, [grid, par_hi, par_lo, child_ebits], prio=prio
+            )
+            cvalid = jnp.arange(cand_cap) < n_valid
+            cand_overflow = n_valid > cand_cap
+            if symmetry:
+                # The representative kernel needs [W] rows; gather candidate
+                # rows once (symmetry models only — the common case keeps
+                # candidates pure plane-major).
+                crows = jnp.stack([ccand[w] for w in range(W)], axis=1)
+                cdw = jax.vmap(dedup_words)(crows)
+                chi, clo = fphash.fingerprint_words(cdw, jnp)
+            else:
+                chi, clo = fphash.fingerprint_planes(ccand, jnp)
+
+            # 4. dedup (candidates are in state-major order, so the insert's
+            #    default arange ticket IS the semantic winner election).
+            table, is_new, ovf = ds.insert(
+                table, chi, clo, cpar_hi, cpar_lo, cvalid, max_probes=max_probes
+            )
+            step_unique = jnp.sum(is_new, dtype=jnp.int32)
+            table_overflow = jnp.any(ovf)
+
+            # 5. terminal detection for eventually counterexamples.
+            terminal = f_valid & ~jnp.any(valid, axis=1)
+            disc_found, disc_fp = terminal_pass(
+                terminal, f_ebits, fhi, flo, disc_found, disc_fp, jnp
+            )
+
+            # 6. survivors -> next frontier rows (stable: semantic order).
+            (new_frontier, new_ebits), new_count = compact_1d(
+                is_new, f_cap, [ccand, cebits], rows_out=(0,)
+            )
+            frontier_overflow = new_count > f_cap
+
+            return (
+                new_frontier,
+                new_ebits,
+                new_count,
+                table,
+                disc_found,
+                disc_fp,
+                step_states,
+                step_unique,
+                table_overflow,
+                frontier_overflow,
+                codec_overflow,
+                cand_overflow,
+                hv_words,
+                hv_fps,
+                hv_counts,
+            )
+
+        return superstep
+
 
     def _build_fused(self, f_cap: int, cand_cap: int):
         """The level loop as a device program: a ``lax.while_loop`` around
@@ -1174,7 +1425,7 @@ class XlaChecker(Checker):
                 RuntimeWarning,
                 stacklevel=2,
             )
-        rows = np.asarray(self._frontier)[: min(n, self._visit_cap)]
+        rows = self._frontier_rows_host()[: min(n, self._visit_cap)]
         parents = self._parent_map()
         for row in rows:
             fp = fphash.fingerprint_u64(self._dedup_words_host(row[None, :])[0], np)

@@ -12,6 +12,8 @@ read", exact in one direction) and relies on the engine to confirm
 candidates with the exact backtracking serializer on the host.
 """
 
+import pytest
+
 from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
 
 
@@ -28,23 +30,29 @@ class ConservativeSingleCopy(PackedSingleCopyRegister):
         return props.at[0].set(self._hist.valid_with_no_return_geq(words, 1))
 
 
-def test_host_verified_full_coverage_confirms_no_candidate():
+@pytest.mark.parametrize("dedup", ["hash", "sorted"])
+def test_host_verified_full_coverage_confirms_no_candidate(dedup):
     """1 server: every flagged candidate passes the exact host check, so
-    full coverage completes with no discovery for the always-property."""
+    full coverage completes with no discovery for the always-property
+    (both visited-set structures: the sorted one also routes the hv
+    candidate compaction through the planes superstep)."""
     m = ConservativeSingleCopy(2, 1)
     xc = m.checker().spawn_xla(
-        frontier_capacity=1 << 10, table_capacity=1 << 12, host_verified_cap=1024
+        frontier_capacity=1 << 10, table_capacity=1 << 12, host_verified_cap=1024,
+        dedup=dedup,
     ).join()
     assert xc.unique_state_count() == 93  # single-copy-register.rs:110
     xc.assert_properties()
 
 
-def test_host_verified_confirms_the_real_counterexample():
+@pytest.mark.parametrize("dedup", ["hash", "sorted"])
+def test_host_verified_confirms_the_real_counterexample(dedup):
     """2 servers: the host serializer must reject spuriously-flagged
     candidates and confirm only a genuinely non-linearizable state."""
     m = ConservativeSingleCopy(2, 2)
     xc = m.checker().spawn_xla(
-        frontier_capacity=1 << 10, table_capacity=1 << 12, host_verified_cap=1024
+        frontier_capacity=1 << 10, table_capacity=1 << 12, host_verified_cap=1024,
+        dedup=dedup,
     ).join()
     witness = xc.discoveries()["linearizable"]
     assert witness.last_state().history.serialized_history() is None

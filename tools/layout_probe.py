@@ -1,0 +1,106 @@
+"""Measure the TPU cost of the engine's array layouts.
+
+Hypothesis: ``[N, W]`` row-major state buffers with tiny minor dims
+(W=2 for 2pc) are tiled by XLA:TPU as (8, 128) blocks with the minor
+dimension padded to 128 lanes — a ~64x memory-traffic blowup on every
+elementwise op and gather over packed-state rows.  If true, the engine
+should hold states as W separate ``[N]`` planes (structure-of-arrays,
+like the visited set already does) instead of ``[N, W]`` rows.
+
+Times, per layout: an elementwise op, a gather by row index (the
+compaction shape), and a vmapped packed_step-style expand.
+
+Usage: python tools/layout_probe.py [--cpu]   (run under timeout)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, n=10):
+    import jax
+
+    jax.block_until_ready(fn())
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n
+
+
+def main() -> None:
+    import jax
+
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    print(f"backend={jax.default_backend()}", flush=True)
+    N, W = 1 << 23, 2
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(0, 2**32, (N, W), dtype=np.uint32))
+    rowsT = jnp.asarray(np.asarray(rows).T.copy())
+    planes = [jnp.asarray(np.asarray(rows)[:, i].copy()) for i in range(W)]
+    idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+    # elementwise
+    dt = timeit(jax.jit(lambda: rows ^ jnp.uint32(0x9E3779B9)))
+    print(f"xor [N,{W}] rows    : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s)", flush=True)
+    dt = timeit(jax.jit(lambda: rowsT ^ jnp.uint32(0x9E3779B9)))
+    print(f"xor [{W},N] transp  : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s)", flush=True)
+    dt = timeit(jax.jit(lambda: [p ^ jnp.uint32(0x9E3779B9) for p in planes]))
+    print(f"xor {W}x[N] planes  : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s)", flush=True)
+
+    # gather rows by index (compaction inner op)
+    dt = timeit(jax.jit(lambda: rows[idx]))
+    print(f"gather [N,{W}] rows : {dt*1e3:8.2f} ms", flush=True)
+    dt = timeit(jax.jit(lambda: rowsT[:, idx]))
+    print(f"gather [{W},N] transp: {dt*1e3:8.2f} ms", flush=True)
+    dt = timeit(jax.jit(lambda: [p[idx] for p in planes]))
+    print(f"gather {W}x[N] planes: {dt*1e3:8.2f} ms", flush=True)
+
+    # argsort-based compaction end to end at grid scale
+    mask = jnp.asarray(rng.integers(0, 4, N, dtype=np.uint32) == 0)
+    cap = N // 4
+
+    def compact_rows():
+        order = jnp.argsort(~mask, stable=True)[:cap]
+        return rows[order]
+
+    def compact_planes():
+        order = jnp.argsort(~mask, stable=True)[:cap]
+        return [p[order] for p in planes]
+
+    dt = timeit(jax.jit(compact_rows), n=3)
+    print(f"compact [N,{W}] rows : {dt*1e3:8.2f} ms", flush=True)
+    dt = timeit(jax.jit(compact_planes), n=3)
+    print(f"compact {W}x[N] planes: {dt*1e3:8.2f} ms", flush=True)
+
+    # sort payload: 5-op 3-key sort with [N] planes (sortedset.insert shape)
+    kh, kl = planes[0], planes[1]
+    tick = jnp.arange(N, dtype=jnp.int32)
+    dt = timeit(jax.jit(lambda: jax.lax.sort((kh, kl, tick, kh, kl), num_keys=3)), n=3)
+    print(f"sort5 3-key [N]    : {dt*1e3:8.2f} ms", flush=True)
+    dt = timeit(jax.jit(lambda: jax.lax.sort((kh, kl, tick), num_keys=3)), n=3)
+    print(f"sort3 3-key [N]    : {dt*1e3:8.2f} ms", flush=True)
+    # 2-key without index payloads (pure dedup shape)
+    dt = timeit(jax.jit(lambda: jax.lax.sort((kh, kl), num_keys=2)), n=3)
+    print(f"sort2 2-key [N]    : {dt*1e3:8.2f} ms", flush=True)
+    # single fused 64-bit key
+    k64 = (planes[0].astype(jnp.uint64) << 32) | planes[1].astype(jnp.uint64)
+    dt = timeit(jax.jit(lambda: jax.lax.sort(k64)), n=3)
+    print(f"sort1 u64 [N]      : {dt*1e3:8.2f} ms", flush=True)
+    t64 = jnp.arange(N, dtype=jnp.int32)
+    dt = timeit(jax.jit(lambda: jax.lax.sort((k64, t64), num_keys=1)), n=3)
+    print(f"sort u64+idx [N]   : {dt*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Per-stage on-chip profile of the sorted-dedup superstep at real shapes.
+
+The committed cost model (BASELINE.md) was measured against the round-2
+hash structure; after the sort-merge visited set landed the bottleneck
+moved and the stage accounting must be re-measured on hardware.  This
+tool times, as separate jits at the rm=8 primary-bench shapes:
+
+  expand     vmap(packed_step) over the frontier bucket
+  fingerprint  two-lane murmur over the candidate buffer
+  compact    gather-based stream compaction of the F*A grid
+  insert     sortedset.insert (the 5-plane 3-key sort + route-back)
+  frontier   gather compaction of survivors into the next frontier
+  superstep  the engine's real fused-per-level program (sum of the above)
+  level-loop the fused 32-level dispatch, from the real checker
+
+plus the same full-coverage measured pass bench.py runs, with per-level
+wall time from one-level dispatches.
+
+Usage: python tools/profile_superstep.py [rm] [--cpu]
+Run under `timeout` — the tunnel wedges rather than failing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, n=5):
+    import jax
+
+    jax.block_until_ready(fn())  # compile / warm
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n
+
+
+def main() -> None:
+    import jax
+
+    if "--cpu" in sys.argv:
+        sys.argv.remove("--cpu")
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+    from stateright_tpu.ops import fphash, sortedset
+
+    rm = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    print(f"backend={jax.default_backend()} rm={rm}", flush=True)
+
+    model = PackedTwoPhaseSys(rm)
+    W, A = model.state_words, model.max_actions
+
+    # Real rm=8 shapes: the big levels run at the 2^18/2^19 buckets with a
+    # 2^22-capacity sorted table.
+    f_cap = 1 << 18
+    table_cap = 1 << 22
+    cand_cap = max(1024, 1 << (f_cap * A // 4 - 1).bit_length())
+    cand_cap = min(cand_cap, 1 << (f_cap * A - 1).bit_length())
+    print(f"W={W} A={A} f_cap=2^{f_cap.bit_length()-1} cand_cap=2^{cand_cap.bit_length()-1}", flush=True)
+
+    rng = np.random.default_rng(0)
+    frontier = jnp.asarray(rng.integers(0, 2**32, (f_cap, W), dtype=np.uint32))
+    mask_grid = jnp.asarray(rng.integers(0, 4, f_cap * A, dtype=np.uint32) == 0)
+
+    # --- expand ---------------------------------------------------------
+    expand = jax.jit(lambda f: jax.vmap(model.packed_step)(f))
+    dt = timeit(lambda: expand(frontier))
+    print(f"expand       [2^{f_cap.bit_length()-1} x A]: {dt*1e3:8.1f} ms ({f_cap*A/dt/1e6:8.1f} M cand/s)", flush=True)
+
+    # --- fingerprint ----------------------------------------------------
+    cand_rows = jnp.asarray(rng.integers(0, 2**32, (cand_cap, W), dtype=np.uint32))
+    fp = jax.jit(lambda r: fphash.fingerprint_words(r, jnp))
+    dt = timeit(lambda: fp(cand_rows))
+    print(f"fingerprint  [2^{cand_cap.bit_length()-1}]: {dt*1e3:8.1f} ms ({cand_cap/dt/1e6:8.1f} M fp/s)", flush=True)
+
+    # --- candidate compaction (grid -> cand buffer) ---------------------
+    grid = jnp.asarray(rng.integers(0, 2**32, (f_cap * A, W), dtype=np.uint32))
+    par = jnp.asarray(rng.integers(0, 2**32, f_cap * A, dtype=np.uint32))
+
+    def compact_gather():
+        order = jnp.argsort(~mask_grid, stable=True)[:cand_cap]
+        sm = mask_grid[order]
+        rows = jnp.where(sm[:, None], grid[order], 0)
+        p = jnp.where(sm, par[order], 0)
+        return rows, p, jnp.sum(mask_grid, dtype=jnp.int32)
+
+    dt = timeit(jax.jit(compact_gather))
+    print(f"compact grid [2^{(f_cap*A-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
+
+    # --- sortedset insert at load --------------------------------------
+    n_occ = (table_cap * 3) // 8
+    keys = rng.integers(1, 2**63, table_cap, dtype=np.uint64)
+    keys[n_occ:] = 0
+    keys[:n_occ] = np.sort(keys[:n_occ])
+    ss = sortedset.SortedSet(
+        jnp.asarray((keys >> 32).astype(np.uint32)),
+        jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32)),
+        jnp.asarray((keys >> 32).astype(np.uint32)),
+        jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32)),
+        jnp.asarray(n_occ, jnp.int32),
+    )
+    chi = jnp.asarray(rng.integers(1, 2**32, cand_cap, dtype=np.uint32))
+    clo = jnp.asarray(rng.integers(1, 2**32, cand_cap, dtype=np.uint32))
+    act = jnp.asarray(rng.integers(0, 2, cand_cap, dtype=np.uint32).astype(bool))
+    ins = jax.jit(sortedset.insert)
+    dt = timeit(lambda: ins(ss, chi, clo, chi, clo, act))
+    print(f"sorted insert[tab 2^{table_cap.bit_length()-1} + 2^{cand_cap.bit_length()-1}]: {dt*1e3:8.1f} ms", flush=True)
+
+    # breakdown: the 5-operand 3-key sort alone, and the argsort compaction alone
+    kh = jnp.concatenate([ss.key_hi, chi])
+    kl = jnp.concatenate([ss.key_lo, clo])
+    tick = jnp.arange(table_cap + cand_cap, dtype=jnp.int32)
+    sort5 = jax.jit(lambda: jax.lax.sort((kh, kl, tick, kh, kl), num_keys=3))
+    dt = timeit(sort5)
+    print(f"  5-op 3-key sort [2^{(table_cap+cand_cap-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
+    keep = jnp.asarray(rng.integers(0, 2, table_cap + cand_cap, dtype=np.uint32).astype(bool))
+    argc = jax.jit(lambda: jnp.argsort(~keep, stable=True)[:table_cap])
+    dt = timeit(argc)
+    print(f"  argsort compaction [2^{(table_cap+cand_cap-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
+
+    # --- the engine's real superstep at this bucket ---------------------
+    c = model.checker().spawn_xla(
+        frontier_capacity=1 << 19, table_capacity=table_cap, levels_per_dispatch=1,
+        dedup="sorted",
+    )
+    step = c._superstep_for(f_cap)
+    ebits = jnp.zeros((f_cap,), jnp.uint32)
+    dt = timeit(lambda: step(frontier, ebits, jnp.int32(f_cap), ss, c._disc_found, c._disc_fp), n=3)
+    print(f"real superstep [bucket 2^{f_cap.bit_length()-1}]: {dt*1e3:8.1f} ms ({f_cap*A/dt/1e6:8.1f} M grid-cand/s)", flush=True)
+
+    # --- full measured pass, one level per dispatch, per-level times ----
+    for lpd in (32, 1):
+        m2 = PackedTwoPhaseSys(rm)
+        kw = dict(frontier_capacity=1 << 19, table_capacity=table_cap,
+                  levels_per_dispatch=lpd, dedup="sorted")
+        t0 = time.monotonic()
+        m2.checker().spawn_xla(**kw).join()
+        warm = time.monotonic() - t0
+        ck = m2.checker().spawn_xla(**kw)
+        t0 = time.monotonic()
+        lvl_times = []
+        while not ck.is_done():
+            t1 = time.monotonic()
+            ck._run_block()
+            lvl_times.append(time.monotonic() - t1)
+        dt = time.monotonic() - t0
+        print(f"full check lpd={lpd}: warm {warm:6.1f}s measured {dt:6.2f}s "
+              f"({ck.state_count()/dt/1e6:6.2f} M gen/s; {ck.state_count():,} gen "
+              f"{ck.unique_state_count():,} uniq depth {ck.max_depth()})", flush=True)
+        if lpd == 1:
+            for lv, t in zip(ck.level_log, lvl_times):
+                print(f"  depth {lv['depth']:3d} frontier {lv['frontier']:9,} gen {lv['generated']:9,} uniq {lv['unique']:9,}  {t*1e3:8.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

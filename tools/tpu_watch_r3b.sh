@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Round-3b tunnel watcher: on recovery, run the layout probe and the
+# superstep stage profile (the evidence the planes-layout decision needs),
+# then stop. Logs -> tpu_watch_r3b.log, tpu_layout_probe.log, tpu_profile.log
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch_r3b.log
+log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+log "watcher started (pid $$)"
+while true; do
+  if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
+    log "TUNNEL UP — layout probe"
+    timeout 1200 python tools/layout_probe.py >tpu_layout_probe.log 2>&1
+    rc1=$?
+    log "layout_probe rc=$rc1"
+    timeout 2400 python tools/profile_superstep.py 8 >tpu_profile.log 2>&1
+    rc2=$?
+    log "profile_superstep rc=$rc2"
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
+      log "both probes done; watcher exiting"
+      exit 0
+    fi
+    log "a probe failed; resuming watch"
+  else
+    log "tunnel down"
+  fi
+  sleep 240
+done
