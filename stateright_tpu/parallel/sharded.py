@@ -29,7 +29,7 @@ import numpy as np
 
 from ..checker.base import Checker
 from ..core import Expectation, Model
-from ..ops import fphash, hashset
+from ..ops import fphash, hashset, sortedset
 from ..xla import XlaChecker, _require_packed
 
 # Owner mix constants: decorrelated from both the fingerprint lanes and the
@@ -75,6 +75,7 @@ class ShardedXlaChecker(Checker):
         visit_cap: int = 4096,
         levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
+        dedup: str = "auto",
     ):
         import jax
         import jax.numpy as jnp
@@ -120,6 +121,20 @@ class ShardedXlaChecker(Checker):
         self._W = model.state_words
         self._A = model.max_actions
         self._P = len(self._properties)
+
+        # Per-shard visited-set structure + bulk-buffer layout, mirroring
+        # the single-chip engine (xla.py): accelerators get the sort-merge
+        # set, plane-major grid/payload buffers, and gather-based packing
+        # and compaction; CPUs keep the hash set + scatter lowerings that
+        # win there. Each shard's table partition is an independent
+        # instance of the structure (ownership routing makes cross-shard
+        # dedup races impossible either way).
+        if dedup == "auto":
+            dedup = "hash" if jax.default_backend() == "cpu" else "sorted"
+        if dedup not in ("hash", "sorted"):
+            raise ValueError(f"dedup must be 'auto', 'hash', or 'sorted': {dedup!r}")
+        self._dedup = dedup
+        self._ds = sortedset if dedup == "sorted" else hashset
 
         D = self._D
         # Capacities learned by earlier checkers of this model over a
@@ -183,10 +198,7 @@ class ShardedXlaChecker(Checker):
         )
         self._counts = jax.device_put(counts, self._plane_sharding)
 
-        table = hashset.make(D * self._Cl, jnp)
-        self._table = hashset.HashSet(
-            *(jax.device_put(p, self._plane_sharding) for p in table)
-        )
+        self._table = self._make_table()
         # Insert init fingerprints (shard-local batches, zero parents).
         zeros = np.zeros_like(fhi)
         n_unique_init = self._bulk_insert(fhi, flo, zeros, zeros, counts)
@@ -216,6 +228,7 @@ class ShardedXlaChecker(Checker):
         written by the single-chip engine (or a different mesh size) loads
         here."""
         import jax
+        import jax.numpy as jnp
 
         from ..checkpoint import load_checkpoint, validate_model
 
@@ -233,12 +246,7 @@ class ShardedXlaChecker(Checker):
         B = max(16, int(counts.max()))
         while self._Cl < 2 * B:
             self._Cl *= 2
-        import jax.numpy as jnp
-
-        table = hashset.make(D * self._Cl, jnp)
-        self._table = hashset.HashSet(
-            *(jax.device_put(p, self._plane_sharding) for p in table)
-        )
+        self._table = self._make_table()
         blocks = [np.zeros((D, B), dtype=np.uint32) for _ in range(4)]
         shard = owners[order]
         for block, lane in zip(blocks, (kh, kl, vh, vl)):
@@ -287,6 +295,53 @@ class ShardedXlaChecker(Checker):
     _path_for = XlaChecker._path_for
     # _parent_map is overridden below: it must gather table planes across
     # processes before indexing them.
+
+    # --- table representation ----------------------------------------------
+    #
+    # The sharded table is the single-chip structure per shard, stored as
+    # GLOBAL planes sharded over the mesh. hash: 4 uint32 planes [D*Cl].
+    # sorted: the same 4 planes plus a [D] int32 plane of per-shard occupied
+    # prefix lengths (SortedSet.n, one scalar per shard). Both reprs keep
+    # the key_hi/key_lo/val_hi/val_lo attribute names and the zero-pad
+    # layout contract, so checkpointing and the native ParentMap consume
+    # either unchanged.
+
+    def _make_table(self):
+        import jax
+        import jax.numpy as jnp
+
+        D = self._D
+        z = jnp.zeros((D * self._Cl,), jnp.uint32)
+        planes = [jax.device_put(z, self._plane_sharding) for _ in range(4)]
+        if self._dedup == "sorted":
+            n = jax.device_put(jnp.zeros((D,), jnp.int32), self._plane_sharding)
+            return sortedset.SortedSet(*planes, n)
+        return hashset.HashSet(*planes)
+
+    def _table_len(self) -> int:
+        return 5 if self._dedup == "sorted" else 4
+
+    def _local_table(self, table):
+        """Per-shard structure from the shard-local plane blocks (inside
+        shard_map: planes are [Cl], the n plane is [1])."""
+        if self._dedup == "sorted":
+            return sortedset.SortedSet(
+                table[0], table[1], table[2], table[3], table[4][0]
+            )
+        return hashset.HashSet(*table)
+
+    @staticmethod
+    def _local_table_out(new_table):
+        """Back to the tuple-of-blocks form (rank-1 n so it shards)."""
+        if isinstance(new_table, sortedset.SortedSet):
+            return (
+                new_table.key_hi,
+                new_table.key_lo,
+                new_table.val_hi,
+                new_table.val_lo,
+                new_table.n[None],
+            )
+        return tuple(new_table)
 
     # --- device programs ---------------------------------------------------
 
@@ -380,25 +435,29 @@ class ShardedXlaChecker(Checker):
 
         D, B = fhi.shape
         max_probes = self._max_probes
+        ds = self._ds
+        TL = self._table_len()
+        local_table = self._local_table
+        local_table_out = self._local_table_out
 
         def build():
             def body(table, fh, fl, vh, vl, count):
                 active = jnp.arange(B) < count[0]
-                table, is_new, ovf = hashset.insert(
-                    hashset.HashSet(*table), fh, fl, vh, vl, active,
+                table, is_new, ovf = ds.insert(
+                    local_table(table), fh, fl, vh, vl, active,
                     max_probes=max_probes,
                 )
                 unique = jax.lax.psum(jnp.sum(is_new, dtype=jnp.int32), "shards")
                 any_ovf = jax.lax.pmax(jnp.any(ovf).astype(jnp.uint32), "shards")
-                return tuple(table), unique, any_ovf
+                return local_table_out(table), unique, any_ovf
 
             return self._shard_map(
                 body,
                 in_specs=(
-                    (P("shards"),) * 4,
+                    (P("shards"),) * TL,
                     P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),
                 ),
-                out_specs=((P("shards"),) * 4, P(), P()),
+                out_specs=((P("shards"),) * TL, P(), P()),
             )
 
         cache = self.__dict__.setdefault("_bulk_insert_cache", {})
@@ -417,8 +476,12 @@ class ShardedXlaChecker(Checker):
             if bool(np.asarray(ovf)):
                 self._grow_table()
                 continue
-            self._table = hashset.HashSet(*planes)
+            self._table = self._global_table(planes)
             return int(np.asarray(unique))
+
+    def _global_table(self, planes):
+        cls = sortedset.SortedSet if self._dedup == "sorted" else hashset.HashSet
+        return cls(*planes)
 
     def _make_local_step(self, Fl: int, Cl: int, K: int):
         """The per-shard superstep body (one BFS level), without the
@@ -435,6 +498,10 @@ class ShardedXlaChecker(Checker):
         P_count = self._P
         max_probes = self._max_probes
         LANES = W + 5  # state words + fp_hi, fp_lo, par_hi, par_lo, ebits
+        ds = self._ds
+        sorted_mode = self._dedup == "sorted"
+        local_table = self._local_table
+        local_table_out = self._local_table_out
 
         def dedup_words(words):
             return model.packed_representative(words) if symmetry else words
@@ -513,66 +580,105 @@ class ShardedXlaChecker(Checker):
                     disc_found, disc_fp, i, viol, fhi, flo
                 )
 
-            # 4. fingerprint candidates and assign owner shards.
-            cand = nxt.reshape(Fl * A, W)
-            cdw = jax.vmap(dedup_words)(cand)
-            chi, clo = fphash.fingerprint_words(cdw, jnp)
-            vflat = valid.reshape(-1)
-            owner = _owner_bits(chi, clo, D, jnp)
-
-            payload = jnp.concatenate(
-                [
-                    cand,
-                    chi[:, None],
-                    clo[:, None],
-                    jnp.broadcast_to(fhi[:, None], (Fl, A)).reshape(-1)[:, None],
-                    jnp.broadcast_to(flo[:, None], (Fl, A)).reshape(-1)[:, None],
-                    jnp.broadcast_to(f_ebits[:, None], (Fl, A)).reshape(-1)[:, None],
-                ],
-                axis=1,
-            )  # [Fl*A, LANES]
-
-            # 5. pack per-destination routing buffers in one sort-by-owner
-            #    pass (each candidate has exactly one destination, so the
-            #    pack is O(Fl*A log) regardless of mesh size). A stable sort
-            #    keeps candidates in frontier order within each destination.
-            #    Inactive slots stay all-zero; (0,0) fingerprints mark them
-            #    empty downstream.
+            # 4-6. fingerprint candidates, assign owner shards, pack
+            #    per-destination routing buffers, all_to_all. Each candidate
+            #    has exactly one destination, so the pack is one
+            #    O(Fl*A log) sort pass regardless of mesh size; candidates
+            #    stay in state-major (frontier) order within each
+            #    destination, so the receiver's insert elects the same
+            #    winners as the single-chip engine. Inactive slots stay
+            #    all-zero; (0,0) fingerprints mark them empty downstream.
+            #
+            #    Two lowerings (same results): the sorted/accelerator path
+            #    keeps the grid plane-major ([W, A*Fl], lane-axis Fl — see
+            #    the xla.py layout note) and GATHERS destination slots from
+            #    the owner-sorted order; the hash/CPU path keeps row-major
+            #    buffers and a scatter pack.
             n_cand = Fl * A
-            owner_eff = jnp.where(vflat, owner.astype(jnp.int32), D)
-            order = jnp.argsort(owner_eff, stable=True)
-            sorted_owner = owner_eff[order]
-            starts = jnp.searchsorted(sorted_owner, jnp.arange(D + 1))
-            route_ovf = jnp.any(starts[1:] - starts[:-1] > K)
-            slot = jnp.arange(n_cand) - starts[jnp.clip(sorted_owner, 0, D - 1)]
-            keep = (sorted_owner < D) & (slot < K)
-            buf = (
-                jnp.zeros((D, K, LANES), jnp.uint32)
-                .at[
-                    jnp.where(keep, sorted_owner, D),
-                    jnp.where(keep, slot, K),
-                    :,
-                ]
-                .set(jnp.where(keep[:, None], payload[order], 0), mode="drop")
-            )
-            route_ovf = jax.lax.pmax(route_ovf.astype(jnp.uint32), "shards") > 0
-
-            # 6. the all-to-all: slice d of the result came from shard d.
-            recv = jax.lax.all_to_all(
-                buf, "shards", split_axis=0, concat_axis=0, tiled=False
-            )
-            recv = recv.reshape(D * K, LANES)
-            r_state = recv[:, :W]
-            r_hi = recv[:, W]
-            r_lo = recv[:, W + 1]
-            r_par_hi = recv[:, W + 2]
-            r_par_lo = recv[:, W + 3]
-            r_ebits = recv[:, W + 4]
+            if sorted_mode:
+                grid = jnp.transpose(nxt, (2, 1, 0)).reshape(W, n_cand)
+                vflat = valid.T.reshape(-1)
+                if symmetry:
+                    crows = jnp.stack([grid[w] for w in range(W)], axis=1)
+                    cdw = jax.vmap(dedup_words)(crows)
+                    chi, clo = fphash.fingerprint_words(cdw, jnp)
+                else:
+                    chi, clo = fphash.fingerprint_planes(grid, jnp)
+                owner = _owner_bits(chi, clo, D, jnp)
+                par_hi = jnp.broadcast_to(fhi[None, :], (A, Fl)).reshape(-1)
+                par_lo = jnp.broadcast_to(flo[None, :], (A, Fl)).reshape(-1)
+                ceb = jnp.broadcast_to(f_ebits[None, :], (A, Fl)).reshape(-1)
+                j = jnp.arange(n_cand, dtype=jnp.int32)
+                prio = (j % Fl) * A + (j // Fl)  # state-major rank f*A + a
+                owner_eff = jnp.where(vflat, owner, D)
+                so, _, order = jax.lax.sort((owner_eff, prio, j), num_keys=2)
+                starts = jnp.searchsorted(so, jnp.arange(D + 1))
+                cnt = starts[1:] - starts[:-1]
+                route_ovf = jnp.any(cnt > K)
+                src = jnp.clip(
+                    starts[:-1][:, None] + jnp.arange(K)[None, :], 0, n_cand - 1
+                )
+                idx = order[src]  # [D, K] payload lanes per destination
+                mask = jnp.arange(K)[None, :] < cnt[:, None]
+                planes = [grid[w] for w in range(W)] + [chi, clo, par_hi, par_lo, ceb]
+                buf = jnp.stack(
+                    [jnp.where(mask, p[idx], jnp.uint32(0)) for p in planes]
+                )  # [LANES, D, K]
+                route_ovf = jax.lax.pmax(route_ovf.astype(jnp.uint32), "shards") > 0
+                recv = jax.lax.all_to_all(
+                    buf, "shards", split_axis=1, concat_axis=1, tiled=False
+                ).reshape(LANES, D * K)
+                r_state = recv[:W]  # [W, D*K] planes
+                r_hi, r_lo = recv[W], recv[W + 1]
+                r_par_hi, r_par_lo = recv[W + 2], recv[W + 3]
+                r_ebits = recv[W + 4]
+            else:
+                cand = nxt.reshape(n_cand, W)
+                cdw = jax.vmap(dedup_words)(cand)
+                chi, clo = fphash.fingerprint_words(cdw, jnp)
+                vflat = valid.reshape(-1)
+                owner = _owner_bits(chi, clo, D, jnp)
+                payload = jnp.concatenate(
+                    [
+                        cand,
+                        chi[:, None],
+                        clo[:, None],
+                        jnp.broadcast_to(fhi[:, None], (Fl, A)).reshape(-1)[:, None],
+                        jnp.broadcast_to(flo[:, None], (Fl, A)).reshape(-1)[:, None],
+                        jnp.broadcast_to(f_ebits[:, None], (Fl, A)).reshape(-1)[:, None],
+                    ],
+                    axis=1,
+                )  # [Fl*A, LANES]
+                owner_eff = jnp.where(vflat, owner.astype(jnp.int32), D)
+                order = jnp.argsort(owner_eff, stable=True)
+                sorted_owner = owner_eff[order]
+                starts = jnp.searchsorted(sorted_owner, jnp.arange(D + 1))
+                route_ovf = jnp.any(starts[1:] - starts[:-1] > K)
+                slot = jnp.arange(n_cand) - starts[jnp.clip(sorted_owner, 0, D - 1)]
+                keep = (sorted_owner < D) & (slot < K)
+                buf = (
+                    jnp.zeros((D, K, LANES), jnp.uint32)
+                    .at[
+                        jnp.where(keep, sorted_owner, D),
+                        jnp.where(keep, slot, K),
+                        :,
+                    ]
+                    .set(jnp.where(keep[:, None], payload[order], 0), mode="drop")
+                )
+                route_ovf = jax.lax.pmax(route_ovf.astype(jnp.uint32), "shards") > 0
+                recv = jax.lax.all_to_all(
+                    buf, "shards", split_axis=0, concat_axis=0, tiled=False
+                ).reshape(D * K, LANES)
+                r_state = recv[:, :W]  # [D*K, W] rows
+                r_hi, r_lo = recv[:, W], recv[:, W + 1]
+                r_par_hi, r_par_lo = recv[:, W + 2], recv[:, W + 3]
+                r_ebits = recv[:, W + 4]
             r_active = (r_hi != 0) | (r_lo != 0)
 
-            # 7. owner-local dedup insert (no cross-shard races possible).
-            new_table, is_new, ovf = hashset.insert(
-                hashset.HashSet(*table),
+            # 7. owner-local dedup insert (no cross-shard races possible;
+            #    both structures share the insert contract).
+            new_table, is_new, ovf = ds.insert(
+                local_table(table),
                 r_hi,
                 r_lo,
                 r_par_hi,
@@ -583,23 +689,39 @@ class ShardedXlaChecker(Checker):
             step_unique = jax.lax.psum(jnp.sum(is_new, dtype=jnp.int32), "shards")
             table_ovf = jax.lax.pmax(jnp.any(ovf).astype(jnp.uint32), "shards") > 0
 
-            # 8. compact the owner's new states into its next local frontier.
-            pos = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+            # 8. compact the owner's new states into its next local
+            #    frontier (gather lowering for sorted/accelerator, scatter
+            #    for hash/CPU; identical results — receiver lane order).
             new_count = jnp.sum(is_new, dtype=jnp.int32)
             frontier_ovf = (
                 jax.lax.pmax((new_count > Fl).astype(jnp.uint32), "shards") > 0
             )
-            idx = jnp.where(is_new & (pos < Fl), pos, Fl)
-            new_frontier = (
-                jnp.zeros((Fl, W), jnp.uint32).at[idx].set(r_state, mode="drop")
-            )
-            new_ebits = jnp.zeros((Fl,), jnp.uint32).at[idx].set(r_ebits, mode="drop")
+            if sorted_mode:
+                order2 = jnp.argsort(~is_new, stable=True)[:Fl]
+                sm = is_new[order2]
+                new_frontier = jnp.stack(
+                    [
+                        jnp.where(sm, r_state[w][order2], jnp.uint32(0))
+                        for w in range(W)
+                    ],
+                    axis=1,
+                )  # [Fl, W] rows (the kernel-facing boundary)
+                new_ebits = jnp.where(sm, r_ebits[order2], jnp.uint32(0))
+            else:
+                pos = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+                idx2 = jnp.where(is_new & (pos < Fl), pos, Fl)
+                new_frontier = (
+                    jnp.zeros((Fl, W), jnp.uint32).at[idx2].set(r_state, mode="drop")
+                )
+                new_ebits = (
+                    jnp.zeros((Fl,), jnp.uint32).at[idx2].set(r_ebits, mode="drop")
+                )
 
             return (
                 new_frontier,
                 new_ebits,
                 new_count[None],
-                tuple(new_table),
+                local_table_out(new_table),
                 disc_found,
                 disc_fp,
                 step_states,
@@ -615,6 +737,7 @@ class ShardedXlaChecker(Checker):
     def _build_superstep(self, Fl: int, Cl: int, K: int):
         from jax.sharding import PartitionSpec as P
 
+        TL = self._table_len()
         spec_rows = P("shards", None)
         spec_plane = P("shards")
         spec_rep = P()
@@ -624,7 +747,7 @@ class ShardedXlaChecker(Checker):
                 spec_rows,
                 spec_plane,
                 spec_plane,
-                (spec_plane,) * 4,
+                (spec_plane,) * TL,
                 spec_rep,
                 spec_rep,
             ),
@@ -632,7 +755,7 @@ class ShardedXlaChecker(Checker):
                 spec_rows,
                 spec_plane,
                 spec_plane,
-                (spec_plane,) * 4,
+                (spec_plane,) * TL,
                 spec_rep,
                 spec_rep,
                 spec_rep,
@@ -718,6 +841,7 @@ class ShardedXlaChecker(Checker):
             out = jax.lax.while_loop(cond, body, carry0)
             return out[1:11]  # drop the level counter and the global count
 
+        TL = self._table_len()
         spec_rows = P("shards", None)
         spec_plane = P("shards")
         spec_rep = P()
@@ -727,7 +851,7 @@ class ShardedXlaChecker(Checker):
                 spec_rows,
                 spec_plane,
                 spec_plane,
-                (spec_plane,) * 4,
+                (spec_plane,) * TL,
                 spec_rep,
                 spec_rep,
                 spec_rep,
@@ -739,7 +863,7 @@ class ShardedXlaChecker(Checker):
                 spec_rows,
                 spec_plane,
                 spec_plane,
-                (spec_plane,) * 4,
+                (spec_plane,) * TL,
                 spec_rep,
                 spec_rep,
                 spec_rep,
@@ -811,20 +935,24 @@ class ShardedXlaChecker(Checker):
 
     def _grow_table_if_loaded(self) -> None:
         """Same proactive-growth policy as the single-chip engine
-        (xla.py MAX_LOAD_*): keep the global load factor at or below 1/4 so
-        inserts never pay long probe chains. Uniform fingerprint ownership
-        keeps per-shard load within noise of the global figure."""
+        (xla.py MAX_LOAD_* / SORTED_LOAD_*): hash partitions stay at or
+        below 1/4 load so inserts never pay long probe chains; sorted
+        partitions run denser (3/4) because their per-level cost is the
+        sort of [capacity + batch], not probe rounds. Uniform fingerprint
+        ownership keeps per-shard load within noise of the global figure."""
         from ..xla import XlaChecker
 
-        while (
-            self._unique_count * XlaChecker.MAX_LOAD_DEN
-            > self._D * self._Cl * XlaChecker.MAX_LOAD_NUM
-        ):
+        if self._dedup == "sorted":
+            num, den = XlaChecker.SORTED_LOAD_NUM, XlaChecker.SORTED_LOAD_DEN
+        else:
+            num, den = XlaChecker.MAX_LOAD_NUM, XlaChecker.MAX_LOAD_DEN
+        while self._unique_count * den > self._D * self._Cl * num:
             self._grow_table()
 
     def _grow_table(self) -> None:
         """Double every shard's table partition (ownership is capacity-
-        independent, so rehash stays shard-local)."""
+        independent, so growth stays shard-local: a plane copy for the
+        sorted structure, a rehash for the hash table)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -833,6 +961,29 @@ class ShardedXlaChecker(Checker):
         old = self._table
         new_Cl = Cl * 2
         max_probes = self._max_probes
+
+        if self._dedup == "sorted":
+
+            def grow_local(planes):
+                kh, kl, vh, vl, n = planes
+                pad = jnp.zeros((Cl,), jnp.uint32)
+                return (
+                    jnp.concatenate([kh, pad]),
+                    jnp.concatenate([kl, pad]),
+                    jnp.concatenate([vh, pad]),
+                    jnp.concatenate([vl, pad]),
+                    n,
+                )
+
+            fn = self._shard_map(
+                grow_local,
+                in_specs=((P("shards"),) * 5,),
+                out_specs=(P("shards"),) * 5,
+            )
+            self._table = sortedset.SortedSet(*fn(tuple(old)))
+            self._Cl = new_Cl
+            self._cap_hints()["table"] = D * new_Cl
+            return
 
         def rehash(old_planes):
             kh, kl, vh, vl = old_planes
@@ -988,7 +1139,7 @@ class ShardedXlaChecker(Checker):
             committed = int(np.asarray(committed))
             self._frontier, self._frontier_ebits = nf, ne
             self._counts = ncounts
-            self._table = hashset.HashSet(*table)
+            self._table = self._global_table(table)
             self._disc_found, self._disc_fp = dfound, dfp
             self._state_count += int(np.asarray(tot_states))
             self._unique_count += int(np.asarray(tot_unique))
@@ -1065,7 +1216,7 @@ class ShardedXlaChecker(Checker):
 
         self._frontier, self._frontier_ebits = nf, ne
         self._counts = ncounts
-        self._table = hashset.HashSet(*table)
+        self._table = self._global_table(table)
         self._disc_found, self._disc_fp = dfound, dfp
         self._state_count += int(np.asarray(d_states))
         self._unique_count += int(np.asarray(d_unique))

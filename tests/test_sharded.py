@@ -171,3 +171,72 @@ def test_sharded_symmetry_reduction_matches_perfect_canonicalizer():
     assert single.unique_state_count() == 8
     assert sharded.unique_state_count() == 8
     assert sharded.state_count() == single.state_count()
+
+
+def test_sharded_sorted_dedup_matches_hash_engine():
+    """The sharded sorted/planes path (per-shard sort-merge set, gather
+    routing pack, gather frontier compaction) is lane-for-lane equivalent
+    to the hash/scatter path: counts, depth, AND witness paths agree."""
+    kw = dict(mesh=_mesh(), frontier_capacity=1 << 10, table_capacity=1 << 13)
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="hash", **kw).join()
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="sorted", **kw).join()
+    assert (a.state_count(), a.unique_state_count(), a.max_depth()) == (
+        b.state_count(),
+        b.unique_state_count(),
+        b.max_depth(),
+    )
+    da, db = a.discoveries(), b.discoveries()
+    assert set(da) == set(db) and da
+    for name in da:
+        assert da[name].into_states() == db[name].into_states()
+
+
+def test_sharded_sorted_matches_single_chip_sorted():
+    """Mesh-vs-single-chip parity under the sorted structure (the TPU
+    default on both engines)."""
+    b = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(
+            mesh=_mesh(), dedup="sorted",
+            frontier_capacity=1 << 10, table_capacity=1 << 13,
+        )
+        .join()
+    )
+    c = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(
+            dedup="sorted", frontier_capacity=1 << 10, table_capacity=1 << 12
+        )
+        .join()
+    )
+    assert b.unique_state_count() == c.unique_state_count() == 288
+    assert b.state_count() == c.state_count()
+    assert b.max_depth() == c.max_depth()
+
+
+def test_sharded_sorted_capacity_autogrowth():
+    """Table/route/frontier growth under the sorted structure: plane-copy
+    growth (no rehash) must preserve the per-shard sorted invariant."""
+    c = (
+        PackedTwoPhaseSys(4)
+        .checker()
+        .spawn_xla(
+            mesh=_mesh(),
+            dedup="sorted",
+            frontier_capacity=1 << 7,
+            table_capacity=1 << 9,
+            route_capacity=4,
+        )
+        .join()
+    )
+    assert c.unique_state_count() == 1_568  # 2pc rm=4 (same anchor as above)
+    kh = np.asarray(c._table.key_hi).reshape(8, -1)
+    kl = np.asarray(c._table.key_lo).reshape(8, -1)
+    ns = np.asarray(c._table.n)
+    for d in range(8):
+        n = int(ns[d])
+        keys = (kh[d, :n].astype(np.uint64) << 32) | kl[d, :n]
+        assert np.all(keys[1:] > keys[:-1]), f"shard {d} prefix not sorted"
+        assert not np.any(kh[d, n:]) and not np.any(kl[d, n:])

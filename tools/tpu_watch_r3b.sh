@@ -1,7 +1,10 @@
 #!/usr/bin/env bash
-# Round-3b tunnel watcher: on recovery, run the layout probe and the
-# superstep stage profile (the evidence the planes-layout decision needs),
-# then stop. Logs -> tpu_watch_r3b.log, tpu_layout_probe.log, tpu_profile.log
+# Round-3b tunnel watcher. On recovery, in priority order (tunnel windows
+# can be short — the committed primary artifact comes before diagnostics):
+#   1. layout probe        (fast; validates the plane-major design on-chip)
+#   2. bench.py            (the primary metric, now on the planes engine)
+#   3. superstep profile   (per-stage accounting for the next optimization)
+# Logs -> tpu_watch_r3b.log, tpu_layout_probe.log, bench_probe.log, tpu_profile.log
 set -u
 cd "$(dirname "$0")/.."
 LOG=tpu_watch_r3b.log
@@ -13,14 +16,19 @@ while true; do
     timeout 1200 python tools/layout_probe.py >tpu_layout_probe.log 2>&1
     rc1=$?
     log "layout_probe rc=$rc1"
-    timeout 2400 python tools/profile_superstep.py 8 >tpu_profile.log 2>&1
+    log "bench.py (primary)"
+    timeout 3000 python bench.py >bench_r3b.json.tmp 2>>"$LOG"
     rc2=$?
-    log "profile_superstep rc=$rc2"
-    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
-      log "both probes done; watcher exiting"
+    log "bench rc=$rc2: $(tail -c 300 bench_r3b.json.tmp 2>/dev/null)"
+    log "superstep profile"
+    timeout 2400 python tools/profile_superstep.py 8 >tpu_profile.log 2>&1
+    rc3=$?
+    log "profile_superstep rc=$rc3"
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]; then
+      log "all stages done; watcher exiting"
       exit 0
     fi
-    log "a probe failed; resuming watch"
+    log "a stage failed; resuming watch"
   else
     log "tunnel down"
   fi
