@@ -611,7 +611,14 @@ class ShardedXlaChecker(Checker):
                 j = jnp.arange(n_cand, dtype=jnp.int32)
                 prio = (j % Fl) * A + (j // Fl)  # state-major rank f*A + a
                 owner_eff = jnp.where(vflat, owner, D)
-                so, _, order = jax.lax.sort((owner_eff, prio, j), num_keys=2)
+                if (D + 1) * n_cand < (1 << 31):
+                    # Fused int32 key (owner, state-major rank): one key
+                    # operand instead of two on the routing sort.
+                    key = owner_eff * jnp.int32(n_cand) + prio
+                    key_s, order = jax.lax.sort((key, j), num_keys=1)
+                    so = key_s // jnp.int32(n_cand)
+                else:  # pragma: no cover - needs a >2^31 global grid
+                    so, _, order = jax.lax.sort((owner_eff, prio, j), num_keys=2)
                 starts = jnp.searchsorted(so, jnp.arange(D + 1))
                 cnt = starts[1:] - starts[:-1]
                 route_ovf = jnp.any(cnt > K)

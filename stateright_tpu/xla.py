@@ -705,9 +705,12 @@ class XlaChecker(Checker):
             if prio is None:
                 order = jnp.argsort(~mask, stable=True)
             else:
-                _, _, order = jax.lax.sort(
-                    ((~mask).astype(jnp.int32), prio, iota), num_keys=2
-                )
+                # One fused int32 key: invalid lanes get a high bit above
+                # every priority (prio < m <= 2^30 here), halving the sort
+                # payload vs (validity, prio) two-key sorting.
+                assert m < (1 << 30)
+                key = jnp.where(mask, prio, prio + jnp.int32(1 << 30))
+                _, order = jax.lax.sort((key, iota), num_keys=1)
             take = min(cap, m)
             order = order[:take]
             smask = mask[order]

@@ -48,10 +48,22 @@ def graph_batch(seed0: int, n: int) -> int:
         dev = PackedDGraph(g).checker().spawn_xla(**KW).join()
         got = (dev.state_count(), dev.unique_state_count(), dev.max_depth())
         assert got == expect, f"seed {seed}: xla {got} != oracle {expect}"
+        srt = PackedDGraph(g).checker().spawn_xla(dedup="sorted", **KW).join()
+        got = (srt.state_count(), srt.unique_state_count(), srt.max_depth())
+        assert got == expect, f"seed {seed}: xla-sorted {got} != oracle {expect}"
         if mesh is not None and seed % 4 == 0:
             sh = PackedDGraph(g).checker().spawn_xla(mesh=mesh, **KW).join()
             got = (sh.state_count(), sh.unique_state_count(), sh.max_depth())
             assert got == expect, f"seed {seed}: sharded {got} != {expect}"
+        if mesh is not None and seed % 4 == 2:
+            sh = (
+                PackedDGraph(g)
+                .checker()
+                .spawn_xla(mesh=mesh, dedup="sorted", **KW)
+                .join()
+            )
+            got = (sh.state_count(), sh.unique_state_count(), sh.max_depth())
+            assert got == expect, f"seed {seed}: sharded-sorted {got} != {expect}"
         if seed % 8 == 0:
             par = g.checker().threads(3).spawn_bfs().join()
             got = (par.state_count(), par.unique_state_count(), par.max_depth())

@@ -91,9 +91,21 @@ def main() -> None:
             flush=True,
         )
 
-    # 4. insert cost vs batch
+    # 4. insert cost vs batch — both visited-set structures at the same
+    #    shapes (the hash/scatter vs sort-merge design decision,
+    #    BASELINE.md cost model).
+    from stateright_tpu.ops import sortedset
+
     table = hashset.make(1 << 22, jnp)
+    n_occ = (3 << 22) // 8  # sorted set at its 3/4-load growth ceiling's half
+    rng0 = np.random.default_rng(9)
+    keys = np.sort(rng0.integers(1, 2**63, n_occ, dtype=np.uint64))
+    stab = sortedset.from_entries(
+        (keys >> 32).astype(np.uint32), (keys & 0xFFFFFFFF).astype(np.uint32),
+        np.zeros(n_occ, np.uint32), np.zeros(n_occ, np.uint32), 1 << 22, jnp,
+    )
     ins = jax.jit(hashset.insert, static_argnames="max_probes")
+    sins = jax.jit(sortedset.insert)
     for pow2 in (14, 17, 20, 22):
         m = 1 << pow2
         rng = np.random.default_rng(0)
@@ -101,8 +113,10 @@ def main() -> None:
         lo = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
         act = jnp.ones((m,), jnp.bool_)
         dt = timeit(lambda: ins(table, hi, lo, hi, lo, act), n=3)
+        ds = timeit(lambda: sins(stab, hi, lo, hi, lo, act), n=3)
         print(
-            f"hashset.insert m=2^{pow2}: {dt*1e3:8.1f} ms  ({m/dt/1e6:8.2f} M ins/s)",
+            f"insert m=2^{pow2}: hash {dt*1e3:8.1f} ms ({m/dt/1e6:7.2f} M/s)  "
+            f"sorted {ds*1e3:8.1f} ms ({m/ds/1e6:7.2f} M/s)",
             flush=True,
         )
 
