@@ -131,3 +131,27 @@ def test_three_client_codec_step_and_bounded_parity():
         h.state_count(),
         h.unique_state_count(),
     ) == (3279, 1969)
+
+
+def test_sorted_dedup_matches_hash_at_wide_state_words():
+    """The planes superstep at Paxos width (W=25 state words, hv
+    linearizability candidates in flight): counts and the discovery set
+    must match the hash/rows engine exactly. Depth-bounded to keep the
+    CPU run short; full coverage is the test above."""
+    kw = dict(
+        frontier_capacity=1 << 11, table_capacity=1 << 14, host_verified_cap=4096
+    )
+    a = (
+        PackedPaxos(2, 3).checker().target_max_depth(9)
+        .spawn_xla(dedup="hash", **kw).join()
+    )
+    b = (
+        PackedPaxos(2, 3).checker().target_max_depth(9)
+        .spawn_xla(dedup="sorted", **kw).join()
+    )
+    assert (a.state_count(), a.unique_state_count(), a.max_depth()) == (
+        b.state_count(),
+        b.unique_state_count(),
+        b.max_depth(),
+    )
+    assert set(a.discoveries()) == set(b.discoveries())
