@@ -36,9 +36,20 @@ plain copy into bigger planes, and capacity overflow is detected exactly
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Tuple
 
 import numpy as np
+
+#: How ``insert`` moves the value planes and the merged keys into place:
+#: ``"gather"`` sorts 3 operands and recovers values/rows with post-sort
+#: gathers (fewest sorted bytes); ``"sort"`` carries them as sort payload
+#: operands (no random gathers — XLA:TPU's sort moves payload at
+#: permutation-network bandwidth while random gathers measured ~15x
+#: slower in the round-3 cost model, so which wins is a hardware
+#: question). Results are bit-identical; differentially tested. The env
+#: var is read at trace time so an on-chip A/B is one process restart.
+VALUES_VIA = os.environ.get("STPU_SORTEDSET_VALUES", "gather")
 
 
 class SortedSet(NamedTuple):
@@ -125,7 +136,13 @@ def insert(
     # is one).
     ticket = jnp.arange(cap + m, dtype=jnp.int32)
 
-    skh, skl, st = jax.lax.sort((kh, kl, ticket), num_keys=3)
+    via_sort = VALUES_VIA == "sort"
+    if via_sort:
+        vh = jnp.concatenate([ss.val_hi, val_hi])
+        vl = jnp.concatenate([ss.val_lo, val_lo])
+        skh, skl, st, svh, svl = jax.lax.sort((kh, kl, ticket, vh, vl), num_keys=3)
+    else:
+        skh, skl, st = jax.lax.sort((kh, kl, ticket), num_keys=3)
 
     run_start = jnp.concatenate(
         [
@@ -141,17 +158,29 @@ def insert(
     overflow = new_n > cap
 
     # Stable compaction of survivors to the front keeps them key-sorted.
-    order = jnp.argsort(~keep, stable=True)[:cap]
     row_ok = jnp.arange(cap) < jnp.minimum(new_n, cap)
     z = jnp.uint32(0)
-    nkh = jnp.where(row_ok, skh[order], z)
-    nkl = jnp.where(row_ok, skl[order], z)
-    # Values of surviving rows, via their pre-sort position.
-    vh = jnp.concatenate([ss.val_hi, val_hi])
-    vl = jnp.concatenate([ss.val_lo, val_lo])
-    src = st[order]
-    nvh = jnp.where(row_ok, vh[src], z)
-    nvl = jnp.where(row_ok, vl[src], z)
+    if via_sort:
+        # Payload-through-sort: the compaction permutation moves every
+        # plane inside one more sort (keep-rank is the key), no gathers.
+        ckey = jnp.where(keep, jnp.int32(0), jnp.int32(1))
+        _, ckh, ckl, cvh, cvl = jax.lax.sort(
+            (ckey, skh, skl, svh, svl), num_keys=1, is_stable=True
+        )
+        nkh = jnp.where(row_ok, ckh[:cap], z)
+        nkl = jnp.where(row_ok, ckl[:cap], z)
+        nvh = jnp.where(row_ok, cvh[:cap], z)
+        nvl = jnp.where(row_ok, cvl[:cap], z)
+    else:
+        order = jnp.argsort(~keep, stable=True)[:cap]
+        nkh = jnp.where(row_ok, skh[order], z)
+        nkl = jnp.where(row_ok, skl[order], z)
+        # Values of surviving rows, via their pre-sort position.
+        vh = jnp.concatenate([ss.val_hi, val_hi])
+        vl = jnp.concatenate([ss.val_lo, val_lo])
+        src = st[order]
+        nvh = jnp.where(row_ok, vh[src], z)
+        nvl = jnp.where(row_ok, vl[src], z)
 
     # Route is_new back to original batch order. Winner tickets are unique,
     # so the scatter is conflict-free; non-winners are routed out of range.

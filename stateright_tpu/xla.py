@@ -62,6 +62,7 @@ kernels over bit-packed uint32 state words:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -138,6 +139,7 @@ class XlaChecker(Checker):
         levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
         dedup: str = "auto",
+        compaction: str = "auto",
     ):
         import jax
 
@@ -186,6 +188,18 @@ class XlaChecker(Checker):
         # superstep's semantics bit-for-bit (candidates are restored to
         # state-major order before the insert's winner election).
         self._soa = dedup == "sorted"
+        # Planes-compaction lowering: "gather" computes the permutation
+        # once (one small sort) and gathers every plane by it; "sort"
+        # carries the planes as sort payload operands — no random gathers,
+        # more sorted bytes. Which wins is a hardware question (the round-3
+        # cost model measured TPU random gathers ~15x below sort payload
+        # bandwidth); results are bit-identical. Env override
+        # STPU_COMPACTION makes the on-chip A/B a process restart.
+        if compaction == "auto":
+            compaction = os.environ.get("STPU_COMPACTION", "gather")
+        if compaction not in ("gather", "sort"):
+            raise ValueError(f"compaction must be 'auto', 'gather', or 'sort': {compaction!r}")
+        self._compaction = compaction
 
         self._max_probes = max_probes
         self._W = model.state_words
@@ -692,50 +706,101 @@ class XlaChecker(Checker):
             nxt, valid = out
             return nxt, valid, jnp.zeros_like(valid)
 
+        sort_compact = self._compaction == "sort"
+
         def compact_1d(mask, cap, arrays, prio=None, rows_out=()):
             """Stream-compact lanes where ``mask`` holds into ``cap`` slots.
             ``arrays`` are 1-D lanes or [W, M] planes (compacted along M);
             indices in ``rows_out`` mark plane entries to emit as [cap, W]
-            rows instead (the kernel/host-facing shape; the gather is by
-            plane either way, only the final stack differs). With ``prio``
+            rows instead (the kernel/host-facing shape). With ``prio``
             survivors come out in ascending prio order (the semantic-order
-            restoration); otherwise stable in array order."""
+            restoration); otherwise stable in array order.
+
+            Two lowerings with identical results (``spawn_xla(compaction=)``,
+            see ``__init__``): "gather" computes the permutation once and
+            gathers every plane; "sort" carries the planes as payload
+            operands of the permutation sort — no random gathers."""
             m = mask.shape[0]
-            iota = jnp.arange(m, dtype=jnp.int32)
+            # One fused int32 key: invalid lanes get a high bit above every
+            # priority (prio < m <= 2^30 here).
+            assert m < (1 << 30)
             if prio is None:
-                order = jnp.argsort(~mask, stable=True)
+                key = jnp.where(mask, jnp.int32(0), jnp.int32(1))
             else:
-                # One fused int32 key: invalid lanes get a high bit above
-                # every priority (prio < m <= 2^30 here), halving the sort
-                # payload vs (validity, prio) two-key sorting.
-                assert m < (1 << 30)
                 key = jnp.where(mask, prio, prio + jnp.int32(1 << 30))
-                _, order = jax.lax.sort((key, iota), num_keys=1)
             take = min(cap, m)
-            order = order[:take]
-            smask = mask[order]
             z32 = jnp.uint32(0)
-            outs = []
+            n_valid = jnp.sum(mask, dtype=jnp.int32)
+
+            # Flatten the inputs into 1-D lanes (planes of 2-D entries).
+            lanes = []
+            shapes = []  # (kind, W) per array: "1d" | "planes" | "rows"
             for pos, a in enumerate(arrays):
                 if a.ndim == 1:
-                    out = jnp.where(smask, a[order], jnp.zeros((), a.dtype))
-                    if take < cap:
-                        out = jnp.concatenate([out, jnp.zeros((cap - take,), a.dtype)])
-                elif pos in rows_out:
-                    planes = [jnp.where(smask, a[w][order], z32) for w in range(a.shape[0])]
-                    out = jnp.stack(planes, axis=1)  # [take, W] rows
-                    if take < cap:
-                        out = jnp.concatenate(
-                            [out, jnp.zeros((cap - take, a.shape[0]), a.dtype)]
-                        )
+                    lanes.append(a)
+                    shapes.append(("1d", None))
                 else:
-                    out = jnp.where(smask[None, :], a[:, order], jnp.zeros((), a.dtype))
-                    if take < cap:
-                        out = jnp.concatenate(
-                            [out, jnp.zeros((a.shape[0], cap - take), a.dtype)], axis=1
-                        )
+                    for w in range(a.shape[0]):
+                        lanes.append(a[w])
+                    shapes.append(
+                        ("rows" if pos in rows_out else "planes", a.shape[0])
+                    )
+
+            if sort_compact:
+                sorted_all = jax.lax.sort(
+                    (key, *lanes), num_keys=1, is_stable=True
+                )
+                skey = sorted_all[0][:take]
+                smask = (
+                    skey == 0 if prio is None else skey < jnp.int32(1 << 30)
+                )
+                slanes = [s[:take] for s in sorted_all[1:]]
+            else:
+                iota = jnp.arange(m, dtype=jnp.int32)
+                _, order = jax.lax.sort((key, iota), num_keys=1)
+                order = order[:take]
+                smask = mask[order]
+                slanes = [lane[order] for lane in lanes]
+
+            def pad(out, pad_shape, dtype, axis=0):
+                if take < cap:
+                    out = jnp.concatenate(
+                        [out, jnp.zeros(pad_shape, dtype)], axis=axis
+                    )
+                return out
+
+            outs = []
+            k = 0
+            for kind, Wn in shapes:
+                if kind == "1d":
+                    lane = slanes[k]
+                    k += 1
+                    out = pad(
+                        jnp.where(smask, lane, jnp.zeros((), lane.dtype)),
+                        (cap - take,),
+                        lane.dtype,
+                    )
+                elif kind == "rows":
+                    rows = [
+                        jnp.where(smask, slanes[k + w], z32) for w in range(Wn)
+                    ]
+                    k += Wn
+                    out = pad(
+                        jnp.stack(rows, axis=1), (cap - take, Wn), rows[0].dtype
+                    )
+                else:
+                    planes = [
+                        jnp.where(smask, slanes[k + w], z32) for w in range(Wn)
+                    ]
+                    k += Wn
+                    out = pad(
+                        jnp.stack(planes),
+                        (Wn, cap - take),
+                        planes[0].dtype,
+                        axis=1,
+                    )
                 outs.append(out)
-            return outs, jnp.sum(mask, dtype=jnp.int32)
+            return outs, n_valid
 
         eval_properties, terminal_pass = self._checking_blocks()
 
@@ -1017,7 +1082,10 @@ class XlaChecker(Checker):
         import jax
 
         cand_cap = self._cand_cap_for(f_cap)
-        key = (f_cap, cand_cap, self._symmetry, self._max_probes, self._dedup)
+        key = (
+            f_cap, cand_cap, self._symmetry, self._max_probes, self._dedup,
+            self._compaction,
+        )
         fn = self._superstep_cache.get(key)
         if fn is None:
             fn = jax.jit(self._build_superstep(f_cap, cand_cap))
@@ -1028,7 +1096,10 @@ class XlaChecker(Checker):
         import jax
 
         cand_cap = self._cand_cap_for(f_cap)
-        key = ("fused", f_cap, cand_cap, self._symmetry, self._max_probes, self._dedup)
+        key = (
+            "fused", f_cap, cand_cap, self._symmetry, self._max_probes,
+            self._dedup, self._compaction,
+        )
         fn = self._superstep_cache.get(key)
         if fn is None:
             fn = jax.jit(self._build_fused(f_cap, cand_cap))

@@ -203,3 +203,37 @@ def test_fingerprint_planes_matches_words():
         )
         assert np.array_equal(wh, np.asarray(jh))
         assert np.array_equal(wl, np.asarray(jl))
+
+
+def test_insert_values_via_sort_matches_gather(monkeypatch):
+    """The payload-through-sort insert lowering is bit-identical to the
+    gather lowering (STPU_SORTEDSET_VALUES; which is faster is a hardware
+    question, correctness is not)."""
+    rng = np.random.default_rng(23)
+    ss_a = sortedset.make(1 << 11, jnp)
+    ss_b = sortedset.make(1 << 11, jnp)
+    for rnd in range(6):
+        hi, lo, vh, vl, act = _rand_batch(rng, 257, 300)
+        monkeypatch.setattr(sortedset, "VALUES_VIA", "gather")
+        ss_a, new_a, ovf_a = sortedset.insert(ss_a, hi, lo, vh, vl, act)
+        monkeypatch.setattr(sortedset, "VALUES_VIA", "sort")
+        ss_b, new_b, ovf_b = sortedset.insert(ss_b, hi, lo, vh, vl, act)
+        for a, b in zip(ss_a, ss_b):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), rnd
+        assert np.array_equal(np.asarray(new_a), np.asarray(new_b)), rnd
+        assert bool(ovf_a) == bool(ovf_b)
+
+
+def test_engine_compaction_sort_matches_gather():
+    """spawn_xla(compaction="sort") (payload-through-sort planes
+    compaction) reproduces the gather engine's counts and witness paths."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    kw = dict(frontier_capacity=1 << 6, table_capacity=1 << 9, dedup="sorted")
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(compaction="gather", **kw).join()
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(compaction="sort", **kw).join()
+    assert _counts(a) == _counts(b)
+    da, db = a.discoveries(), b.discoveries()
+    assert set(da) == set(db) and da
+    for name in da:
+        assert da[name].into_states() == db[name].into_states()
