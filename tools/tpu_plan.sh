@@ -58,10 +58,18 @@ def soak(name, build, runs=2, budget_s=900, **kw):
     print(f"[soak] {name}: counts {'STABLE' if stable else 'UNSTABLE'} across {runs} runs", flush=True)
 
 from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
-soak("2pc rm=10", lambda: PackedTwoPhaseSys(10),
-     frontier_capacity=1 << 20, table_capacity=1 << 25)
-soak("2pc rm=12", lambda: PackedTwoPhaseSys(12), budget_s=1200,
+# Unique-state growth is ~5.9x per RM (8,832 @ rm=5 ... 1,745,408 @ rm=8):
+# rm=9 ~ 10M uniques, rm=10 ~ 60M. The sorted set runs at 3/4 load, so
+# rm=10 needs a 2^27-row table (2.1 GB of planes in HBM) up front —
+# pre-size it: every growth step at this scale is a recompile.
+soak("2pc rm=9", lambda: PackedTwoPhaseSys(9),
+     frontier_capacity=1 << 20, table_capacity=1 << 24)
+soak("2pc rm=10", lambda: PackedTwoPhaseSys(10), budget_s=1200,
      frontier_capacity=1 << 21, table_capacity=1 << 27)
+# rm=11 (~360M uniques) exceeds full coverage in budget; a bounded run
+# still measures steady-state gen/s at 2^28 table scale (4.3 GB planes).
+soak("2pc rm=11 (bounded)", lambda: PackedTwoPhaseSys(11), runs=1,
+     budget_s=900, frontier_capacity=1 << 22, table_capacity=1 << 28)
 from stateright_tpu.models.paxos import PackedPaxos
 soak("paxos 3c/3s", lambda: PackedPaxos(3, 3), budget_s=1200,
      frontier_capacity=1 << 19, table_capacity=1 << 25)
