@@ -182,10 +182,19 @@ def insert(
         nvh = jnp.where(row_ok, vh[src], z)
         nvl = jnp.where(row_ok, vl[src], z)
 
-    # Route is_new back to original batch order. Winner tickets are unique,
-    # so the scatter is conflict-free; non-winners are routed out of range.
-    idx = jnp.where(winner, st - cap, m)
-    is_new = jnp.zeros((m,), jnp.bool_).at[idx].set(True, mode="drop")
+    # Route is_new back to original batch order.
+    if via_sort:
+        # Scatter-free: sorting (ticket, winner) by ticket is the inverse
+        # permutation; candidate lanes are the tail cap:.
+        _, winner_in_order = jax.lax.sort(
+            (st, winner.astype(jnp.int32)), num_keys=1
+        )
+        is_new = winner_in_order[cap:].astype(jnp.bool_)
+    else:
+        # Winner tickets are unique, so the scatter is conflict-free;
+        # non-winners are routed out of range.
+        idx = jnp.where(winner, st - cap, m)
+        is_new = jnp.zeros((m,), jnp.bool_).at[idx].set(True, mode="drop")
 
     return SortedSet(nkh, nkl, nvh, nvl, jnp.minimum(new_n, cap)), is_new, overflow
 
