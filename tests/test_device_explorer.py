@@ -141,3 +141,28 @@ def test_join_before_unblock_raises():
     checker.check_state(next(iter(checker.model().init_states())))
     with pytest.raises(RuntimeError, match="run_to_completion"):
         checker.join()
+
+
+def test_device_on_demand_sorted_dedup_parity():
+    """The demand-driven device checker over the sorted structure (what a
+    TPU-backed Explorer runs): click-for-click results match the hash
+    structure's."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    out = {}
+    for dedup in ("hash", "sorted"):
+        m = PackedTwoPhaseSys(3)
+        c = m.checker().spawn_on_demand(
+            engine="xla",
+            dedup=dedup,
+            frontier_capacity=1 << 8,
+            table_capacity=1 << 10,
+        )
+        init = m.init_states()[0]
+        c.check_state(init)
+        lvl1 = sorted(c._pool)  # pending children after one click
+        c.run_to_completion()
+        c.join()
+        out[dedup] = (lvl1, c.state_count(), c.unique_state_count(), c.max_depth())
+    assert out["hash"] == out["sorted"]
+    assert out["hash"][2] == 288
