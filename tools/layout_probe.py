@@ -1,16 +1,21 @@
-"""Measure the TPU cost of the engine's array layouts.
+"""Measure the TPU cost of the engine's array layouts and primitives.
 
-Hypothesis: ``[N, W]`` row-major state buffers with tiny minor dims
-(W=2 for 2pc) are tiled by XLA:TPU as (8, 128) blocks with the minor
-dimension padded to 128 lanes — a ~64x memory-traffic blowup on every
-elementwise op and gather over packed-state rows.  If true, the engine
-should hold states as W separate ``[N]`` planes (structure-of-arrays,
-like the visited set already does) instead of ``[N, W]`` rows.
+Answers four hardware questions the engine design hinges on:
 
-Times, per layout: an elementwise op, a gather by row index (the
-compaction shape), and a vmapped packed_step-style expand.
+1. the (8, 128) minor-dim tiling tax — elementwise/gather over ``[N, W]``
+   row buffers (W=2) vs ``[W, N]`` transposed vs W separate ``[N]`` planes;
+2. random 1-D gather throughput (the gather-vs-sort compaction decision,
+   and whether a searchsorted/delta visited-set design could beat the
+   per-level full-table sort);
+3. sort cost vs operand count (payload-through-sort vs gather lowerings;
+   2-key u32 pairs vs one fused u64 key);
+4. scatter throughput (the is_new routing scatter).
 
-Usage: python tools/layout_probe.py [--cpu]   (run under timeout)
+All timed computations take their inputs as jit ARGUMENTS — a jitted
+closure over device arrays is constant-folded by XLA at compile time and
+times nothing (the bug that invalidated this tool's first draft).
+
+Usage: python tools/layout_probe.py [--cpu] [pow]   (run under timeout)
 """
 
 from __future__ import annotations
@@ -24,13 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def timeit(fn, n=10):
+def timeit(fn, *args, n=10):
     import jax
 
-    jax.block_until_ready(fn())
+    jax.block_until_ready(fn(*args))
     t0 = time.monotonic()
     for _ in range(n):
-        out = fn()
+        out = fn(*args)
     jax.block_until_ready(out)
     return (time.monotonic() - t0) / n
 
@@ -39,67 +44,100 @@ def main() -> None:
     import jax
 
     if "--cpu" in sys.argv:
+        sys.argv.remove("--cpu")
         jax.config.update("jax_platforms", "cpu")
+    # The engine is u32-only; x64 is enabled here just so the fused-u64-key
+    # sort rows measure real 64-bit sorts instead of silently truncating.
+    jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
 
-    print(f"backend={jax.default_backend()}", flush=True)
-    N, W = 1 << 23, 2
+    pow_n = int(sys.argv[1]) if len(sys.argv) > 1 else 23
+    N, W = 1 << pow_n, 2
+    print(f"backend={jax.default_backend()} N=2^{pow_n} W={W}", flush=True)
     rng = np.random.default_rng(0)
     rows = jnp.asarray(rng.integers(0, 2**32, (N, W), dtype=np.uint32))
     rowsT = jnp.asarray(np.asarray(rows).T.copy())
-    planes = [jnp.asarray(np.asarray(rows)[:, i].copy()) for i in range(W)]
+    p0 = jnp.asarray(np.asarray(rows)[:, 0].copy())
+    p1 = jnp.asarray(np.asarray(rows)[:, 1].copy())
     idx = jnp.asarray(rng.permutation(N).astype(np.int32))
 
-    # elementwise
-    dt = timeit(jax.jit(lambda: rows ^ jnp.uint32(0x9E3779B9)))
-    print(f"xor [N,{W}] rows    : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s)", flush=True)
-    dt = timeit(jax.jit(lambda: rowsT ^ jnp.uint32(0x9E3779B9)))
-    print(f"xor [{W},N] transp  : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s)", flush=True)
-    dt = timeit(jax.jit(lambda: [p ^ jnp.uint32(0x9E3779B9) for p in planes]))
-    print(f"xor {W}x[N] planes  : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s)", flush=True)
+    # 1. elementwise across layouts
+    xor_rows = jax.jit(lambda r: r ^ jnp.uint32(0x9E3779B9))
+    dt = timeit(xor_rows, rows)
+    print(f"xor [N,{W}] rows    : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s logical)", flush=True)
+    dt = timeit(xor_rows, rowsT)
+    print(f"xor [{W},N] transp  : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s logical)", flush=True)
+    xor_planes = jax.jit(lambda a, b: (a ^ jnp.uint32(0x9E3779B9), b ^ jnp.uint32(0x9E3779B9)))
+    dt = timeit(xor_planes, p0, p1)
+    print(f"xor {W}x[N] planes  : {dt*1e3:8.2f} ms ({N*W*4/dt/1e9:7.1f} GB/s logical)", flush=True)
 
-    # gather rows by index (compaction inner op)
-    dt = timeit(jax.jit(lambda: rows[idx]))
-    print(f"gather [N,{W}] rows : {dt*1e3:8.2f} ms", flush=True)
-    dt = timeit(jax.jit(lambda: rowsT[:, idx]))
-    print(f"gather [{W},N] transp: {dt*1e3:8.2f} ms", flush=True)
-    dt = timeit(jax.jit(lambda: [p[idx] for p in planes]))
-    print(f"gather {W}x[N] planes: {dt*1e3:8.2f} ms", flush=True)
+    # 2. gathers
+    grow = jax.jit(lambda r, i: r[i])
+    dt = timeit(grow, rows, idx)
+    print(f"gather [N,{W}] rows : {dt*1e3:8.2f} ms ({N/dt/1e6:7.1f} M rows/s)", flush=True)
+    gplane = jax.jit(lambda a, b, i: (a[i], b[i]))
+    dt = timeit(gplane, p0, p1, idx)
+    print(f"gather {W}x[N] plane: {dt*1e3:8.2f} ms ({N*W/dt/1e6:7.1f} M elem/s)", flush=True)
+    # sorted-ascending indices (searchsorted-ish locality, best case)
+    idx_sorted = jnp.asarray(np.sort(np.asarray(idx)))
+    dt = timeit(gplane, p0, p1, idx_sorted)
+    print(f"gather {W}x[N] asc  : {dt*1e3:8.2f} ms ({N*W/dt/1e6:7.1f} M elem/s)", flush=True)
 
-    # argsort-based compaction end to end at grid scale
-    mask = jnp.asarray(rng.integers(0, 4, N, dtype=np.uint32) == 0)
-    cap = N // 4
+    # 3. scatter (is_new-routing shape: bool by unique indices)
+    scat = jax.jit(
+        lambda i: jnp.zeros((N,), jnp.bool_).at[i].set(True, mode="drop")
+    )
+    dt = timeit(scat, idx)
+    print(f"scatter bool [N]   : {dt*1e3:8.2f} ms ({N/dt/1e6:7.1f} M elem/s)", flush=True)
 
-    def compact_rows():
-        order = jnp.argsort(~mask, stable=True)[:cap]
-        return rows[order]
-
-    def compact_planes():
-        order = jnp.argsort(~mask, stable=True)[:cap]
-        return [p[order] for p in planes]
-
-    dt = timeit(jax.jit(compact_rows), n=3)
-    print(f"compact [N,{W}] rows : {dt*1e3:8.2f} ms", flush=True)
-    dt = timeit(jax.jit(compact_planes), n=3)
-    print(f"compact {W}x[N] planes: {dt*1e3:8.2f} ms", flush=True)
-
-    # sort payload: 5-op 3-key sort with [N] planes (sortedset.insert shape)
-    kh, kl = planes[0], planes[1]
+    # 4. sorts: operand-count scaling + fused u64 key
     tick = jnp.arange(N, dtype=jnp.int32)
-    dt = timeit(jax.jit(lambda: jax.lax.sort((kh, kl, tick, kh, kl), num_keys=3)), n=3)
-    print(f"sort5 3-key [N]    : {dt*1e3:8.2f} ms", flush=True)
-    dt = timeit(jax.jit(lambda: jax.lax.sort((kh, kl, tick), num_keys=3)), n=3)
-    print(f"sort3 3-key [N]    : {dt*1e3:8.2f} ms", flush=True)
-    # 2-key without index payloads (pure dedup shape)
-    dt = timeit(jax.jit(lambda: jax.lax.sort((kh, kl), num_keys=2)), n=3)
-    print(f"sort2 2-key [N]    : {dt*1e3:8.2f} ms", flush=True)
-    # single fused 64-bit key
-    k64 = (planes[0].astype(jnp.uint64) << 32) | planes[1].astype(jnp.uint64)
-    dt = timeit(jax.jit(lambda: jax.lax.sort(k64)), n=3)
-    print(f"sort1 u64 [N]      : {dt*1e3:8.2f} ms", flush=True)
-    t64 = jnp.arange(N, dtype=jnp.int32)
-    dt = timeit(jax.jit(lambda: jax.lax.sort((k64, t64), num_keys=1)), n=3)
-    print(f"sort u64+idx [N]   : {dt*1e3:8.2f} ms", flush=True)
+    s2 = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=2))
+    dt = timeit(s2, p0, p1, n=3)
+    print(f"sort 2-key 2-op    : {dt*1e3:8.2f} ms ({N/dt/1e6:7.1f} M keys/s)", flush=True)
+    s3 = jax.jit(lambda a, b, t: jax.lax.sort((a, b, t), num_keys=3))
+    dt = timeit(s3, p0, p1, tick, n=3)
+    print(f"sort 3-key 3-op    : {dt*1e3:8.2f} ms", flush=True)
+    s5 = jax.jit(lambda a, b, t, c, d: jax.lax.sort((a, b, t, c, d), num_keys=3))
+    dt = timeit(s5, p0, p1, tick, p0, p1, n=3)
+    print(f"sort 3-key 5-op    : {dt*1e3:8.2f} ms", flush=True)
+    s8 = jax.jit(
+        lambda a, b, t, c, d, e, f, g: jax.lax.sort(
+            (a, b, t, c, d, e, f, g), num_keys=3
+        )
+    )
+    dt = timeit(s8, p0, p1, tick, p0, p1, p0, p1, tick, n=3)
+    print(f"sort 3-key 8-op    : {dt*1e3:8.2f} ms", flush=True)
+    k64j = jax.jit(lambda a, b: (a.astype(jnp.uint64) << 32) | b)
+    k64 = k64j(p0, p1)
+    s1u = jax.jit(lambda k: jax.lax.sort(k))
+    dt = timeit(s1u, k64, n=3)
+    print(f"sort u64 1-op      : {dt*1e3:8.2f} ms", flush=True)
+    s2u = jax.jit(lambda k, t: jax.lax.sort((k, t), num_keys=1))
+    dt = timeit(s2u, k64, tick, n=3)
+    print(f"sort u64 + idx     : {dt*1e3:8.2f} ms", flush=True)
+    # 1-key i32 + payload (the engine's fused compaction key shape)
+    ki = jnp.asarray(rng.integers(0, 2**30, N, dtype=np.int32))
+    s2i = jax.jit(lambda k, t: jax.lax.sort((k, t), num_keys=1))
+    dt = timeit(s2i, ki, tick, n=3)
+    print(f"sort i32 + idx     : {dt*1e3:8.2f} ms", flush=True)
+
+    # 5. searchsorted-style binary search: log2(N) rounds of gathers
+    def bsearch(keys, queries):
+        off = jnp.zeros(queries.shape, jnp.int32)
+        step = keys.shape[0]
+        while step > 1:
+            step //= 2
+            mid = off + step
+            less = keys[jnp.minimum(mid, keys.shape[0] - 1)] <= queries
+            off = jnp.where(less, mid, off)
+        return off
+
+    skeys = jnp.asarray(np.sort(rng.integers(0, 2**63, N, dtype=np.uint64)))
+    queries = jnp.asarray(rng.integers(0, 2**63, N // 2, dtype=np.uint64))
+    bs = jax.jit(bsearch)
+    dt = timeit(bs, skeys, queries, n=3)
+    print(f"bsearch [N/2] in [N]: {dt*1e3:8.2f} ms ({(N//2)/dt/1e6:7.1f} M lookups/s)", flush=True)
 
 
 if __name__ == "__main__":

@@ -31,13 +31,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def timeit(fn, n=5):
+def timeit(fn, *args, n=5):
     import jax
 
-    jax.block_until_ready(fn())  # compile / warm
+    jax.block_until_ready(fn(*args))  # compile / warm
     t0 = time.monotonic()
     for _ in range(n):
-        out = fn()
+        out = fn(*args)
     jax.block_until_ready(out)
     return (time.monotonic() - t0) / n
 
@@ -82,18 +82,19 @@ def main() -> None:
     dt = timeit(lambda: fp(cand_rows))
     print(f"fingerprint  [2^{cand_cap.bit_length()-1}]: {dt*1e3:8.1f} ms ({cand_cap/dt/1e6:8.1f} M fp/s)", flush=True)
 
-    # --- candidate compaction (grid -> cand buffer) ---------------------
-    grid = jnp.asarray(rng.integers(0, 2**32, (f_cap * A, W), dtype=np.uint32))
+    # --- candidate compaction (grid -> cand buffer; planes form) --------
+    gplanes = jnp.asarray(rng.integers(0, 2**32, (W, f_cap * A), dtype=np.uint32))
     par = jnp.asarray(rng.integers(0, 2**32, f_cap * A, dtype=np.uint32))
 
-    def compact_gather():
-        order = jnp.argsort(~mask_grid, stable=True)[:cand_cap]
-        sm = mask_grid[order]
-        rows = jnp.where(sm[:, None], grid[order], 0)
+    def compact_gather(mask, gp, par):
+        order = jnp.argsort(~mask, stable=True)[:cand_cap]
+        sm = mask[order]
+        rows = jnp.where(sm[None, :], gp[:, order], 0)
         p = jnp.where(sm, par[order], 0)
-        return rows, p, jnp.sum(mask_grid, dtype=jnp.int32)
+        return rows, p, jnp.sum(mask, dtype=jnp.int32)
 
-    dt = timeit(jax.jit(compact_gather))
+    compact_j = jax.jit(compact_gather)
+    dt = timeit(compact_j, mask_grid, gplanes, par, n=3)
     print(f"compact grid [2^{(f_cap*A-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
 
     # --- sortedset insert at load --------------------------------------
@@ -115,16 +116,19 @@ def main() -> None:
     dt = timeit(lambda: ins(ss, chi, clo, chi, clo, act))
     print(f"sorted insert[tab 2^{table_cap.bit_length()-1} + 2^{cand_cap.bit_length()-1}]: {dt*1e3:8.1f} ms", flush=True)
 
-    # breakdown: the 5-operand 3-key sort alone, and the argsort compaction alone
+    # breakdown: the insert's component sorts at its [cap + m] shape
     kh = jnp.concatenate([ss.key_hi, chi])
     kl = jnp.concatenate([ss.key_lo, clo])
     tick = jnp.arange(table_cap + cand_cap, dtype=jnp.int32)
-    sort5 = jax.jit(lambda: jax.lax.sort((kh, kl, tick, kh, kl), num_keys=3))
-    dt = timeit(sort5)
+    sort3 = jax.jit(lambda a, b, t: jax.lax.sort((a, b, t), num_keys=3))
+    dt = timeit(sort3, kh, kl, tick, n=3)
+    print(f"  3-op 3-key sort [2^{(table_cap+cand_cap-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
+    sort5 = jax.jit(lambda a, b, t, c, d: jax.lax.sort((a, b, t, c, d), num_keys=3))
+    dt = timeit(sort5, kh, kl, tick, kh, kl, n=3)
     print(f"  5-op 3-key sort [2^{(table_cap+cand_cap-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
     keep = jnp.asarray(rng.integers(0, 2, table_cap + cand_cap, dtype=np.uint32).astype(bool))
-    argc = jax.jit(lambda: jnp.argsort(~keep, stable=True)[:table_cap])
-    dt = timeit(argc)
+    argc = jax.jit(lambda k: jnp.argsort(~k, stable=True)[:table_cap])
+    dt = timeit(argc, keep, n=3)
     print(f"  argsort compaction [2^{(table_cap+cand_cap-1).bit_length()}]: {dt*1e3:8.1f} ms", flush=True)
 
     # --- the engine's real superstep at this bucket ---------------------
@@ -159,6 +163,26 @@ def main() -> None:
         if lpd == 1:
             for lv, t in zip(ck.level_log, lvl_times):
                 print(f"  depth {lv['depth']:3d} frontier {lv['frontier']:9,} gen {lv['generated']:9,} uniq {lv['unique']:9,}  {t*1e3:8.1f} ms", flush=True)
+
+    # --- A/B: gather-family vs sort-family lowerings, end to end --------
+    # (insert-values + is_new routing via STPU_SORTEDSET_VALUES, planes
+    # compaction via spawn_xla(compaction=); fresh model instances so the
+    # in-process superstep cache cannot mix lowerings.)
+    for values_via, comp in (("gather", "gather"), ("sort", "sort")):
+        sortedset.VALUES_VIA = values_via
+        m3 = PackedTwoPhaseSys(rm)
+        kw = dict(frontier_capacity=1 << 19, table_capacity=table_cap,
+                  dedup="sorted", compaction=comp)
+        t0 = time.monotonic()
+        m3.checker().spawn_xla(**kw).join()
+        warm = time.monotonic() - t0
+        t0 = time.monotonic()
+        ck = m3.checker().spawn_xla(**kw).join()
+        dt = time.monotonic() - t0
+        print(f"A/B values={values_via} compaction={comp}: warm {warm:6.1f}s "
+              f"measured {dt:6.2f}s ({ck.state_count()/dt/1e6:6.2f} M gen/s)",
+              flush=True)
+    sortedset.VALUES_VIA = "gather"
 
 
 if __name__ == "__main__":
