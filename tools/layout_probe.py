@@ -108,14 +108,17 @@ def main() -> None:
     )
     dt = timeit(s8, p0, p1, tick, p0, p1, p0, p1, tick, n=3)
     print(f"sort 3-key 8-op    : {dt*1e3:8.2f} ms", flush=True)
-    k64j = jax.jit(lambda a, b: (a.astype(jnp.uint64) << 32) | b)
-    k64 = k64j(p0, p1)
-    s1u = jax.jit(lambda k: jax.lax.sort(k))
-    dt = timeit(s1u, k64, n=3)
-    print(f"sort u64 1-op      : {dt*1e3:8.2f} ms", flush=True)
-    s2u = jax.jit(lambda k, t: jax.lax.sort((k, t), num_keys=1))
-    dt = timeit(s2u, k64, tick, n=3)
-    print(f"sort u64 + idx     : {dt*1e3:8.2f} ms", flush=True)
+    try:
+        k64j = jax.jit(lambda a, b: (a.astype(jnp.uint64) << 32) | b)
+        k64 = k64j(p0, p1)
+        s1u = jax.jit(lambda k: jax.lax.sort(k))
+        dt = timeit(s1u, k64, n=3)
+        print(f"sort u64 1-op      : {dt*1e3:8.2f} ms", flush=True)
+        s2u = jax.jit(lambda k, t: jax.lax.sort((k, t), num_keys=1))
+        dt = timeit(s2u, k64, tick, n=3)
+        print(f"sort u64 + idx     : {dt*1e3:8.2f} ms", flush=True)
+    except Exception as e:  # 64-bit ints may not lower on this backend
+        print(f"sort u64: unavailable ({type(e).__name__})", flush=True)
     # 1-key i32 + payload (the engine's fused compaction key shape)
     ki = jnp.asarray(rng.integers(0, 2**30, N, dtype=np.int32))
     s2i = jax.jit(lambda k, t: jax.lax.sort((k, t), num_keys=1))
@@ -133,8 +136,8 @@ def main() -> None:
             off = jnp.where(less, mid, off)
         return off
 
-    skeys = jnp.asarray(np.sort(rng.integers(0, 2**63, N, dtype=np.uint64)))
-    queries = jnp.asarray(rng.integers(0, 2**63, N // 2, dtype=np.uint64))
+    skeys = jnp.asarray(np.sort(rng.integers(0, 2**32, N, dtype=np.uint32)))
+    queries = jnp.asarray(rng.integers(0, 2**32, N // 2, dtype=np.uint32))
     bs = jax.jit(bsearch)
     dt = timeit(bs, skeys, queries, n=3)
     print(f"bsearch [N/2] in [N]: {dt*1e3:8.2f} ms ({(N//2)/dt/1e6:7.1f} M lookups/s)", flush=True)
