@@ -1,0 +1,310 @@
+"""Two-tier sort-merge visited set: sorted MAIN array + sorted DELTA.
+
+The flat sorted set (``ops/sortedset.py``) pays one ``lax.sort`` of
+``[capacity + batch]`` per level — at soak scale (2pc rm=10: a 2^27-row
+table) that term dominates every level regardless of how small the level
+is.  This structure bounds the per-level sort to the DELTA tier, LSM-style:
+
+- **membership** against the main tier is a branchless binary-search
+  descent (log2(C) rounds of gathers; candidates are probed in sorted
+  order, so the access pattern is ascending — the high-locality gather
+  case of ``tools/layout_probe.py``),
+- **in-batch dedup + winner election + delta merge** is one sort of
+  ``[delta_capacity + batch]`` (the sortedset pipeline, small tier only),
+- when the merged delta would overflow, the same compiled program
+  **flushes**: one sort of ``[C + Dcap + batch]`` folds the delta and the
+  batch winners into main and empties the delta.  ``lax.cond`` picks the
+  path on device, so flushes cost no host round-trip and no retry.
+
+Amortization: the big sort runs once per ~(Dcap / level-batch) levels
+instead of every level.  Same insert contract as the other structures
+(is_new in batch order, lowest-index winner, parent values stored);
+differential tests pin equality against them.
+
+External layout contract: ``key_hi/key_lo/val_hi/val_lo`` expose the
+CONCATENATED [main ‖ delta] planes (occupied rows non-(0,0), pads zero),
+so the checkpoint codec and the native ParentMap consume this structure
+unchanged.  (0xFFFFFFFF, 0xFFFFFFFF) is reserved exactly as in the flat
+sorted set.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+
+class DeltaSet(NamedTuple):
+    """Tier planes: ``main_*`` rows ``[:n_main]`` sorted ascending by
+    (hi, lo) and unique; ``delta_*`` rows ``[:n_delta]`` likewise; the two
+    tiers are disjoint. Pads are (0, 0)."""
+
+    main_key_hi: "jax.Array"  # [C] uint32
+    main_key_lo: "jax.Array"
+    main_val_hi: "jax.Array"
+    main_val_lo: "jax.Array"
+    delta_key_hi: "jax.Array"  # [Dc] uint32
+    delta_key_lo: "jax.Array"
+    delta_val_hi: "jax.Array"
+    delta_val_lo: "jax.Array"
+    n_main: "jax.Array"  # [] int32
+    n_delta: "jax.Array"  # [] int32
+
+    @property
+    def capacity(self) -> int:
+        """Total row slots (the growth policy's denominator)."""
+        return self.main_key_hi.shape[0] + self.delta_key_hi.shape[0]
+
+    @property
+    def main_capacity(self) -> int:
+        return self.main_key_hi.shape[0]
+
+    @property
+    def delta_capacity(self) -> int:
+        return self.delta_key_hi.shape[0]
+
+    # --- external layout contract (checkpoint / ParentMap) ---------------
+
+    @property
+    def key_hi(self):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([self.main_key_hi, self.delta_key_hi])
+
+    @property
+    def key_lo(self):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([self.main_key_lo, self.delta_key_lo])
+
+    @property
+    def val_hi(self):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([self.main_val_hi, self.delta_val_hi])
+
+    @property
+    def val_lo(self):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([self.main_val_lo, self.delta_val_lo])
+
+
+#: Delta-tier rows as a fraction of main capacity (1/2**DELTA_SHIFT).
+DELTA_SHIFT = 4
+
+
+def _delta_cap(capacity: int) -> int:
+    return max(capacity >> DELTA_SHIFT, 1024)
+
+
+def make(capacity: int, xp) -> DeltaSet:
+    """Empty set. ``capacity`` counts MAIN rows (power of two); the delta
+    tier adds capacity/2**DELTA_SHIFT rows on top."""
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    dc = _delta_cap(capacity)
+    zc = xp.zeros((capacity,), dtype=xp.uint32)
+    zd = xp.zeros((dc,), dtype=xp.uint32)
+    zero = xp.asarray(0, dtype=xp.int32)
+    return DeltaSet(zc, zc, zc, zc, zd, zd, zd, zd, zero, zero)
+
+
+def from_entries(key_hi, key_lo, val_hi, val_lo, capacity: int, xp) -> DeltaSet:
+    """Host-side bulk build (checkpoint restore): everything lands sorted
+    in the main tier; the delta starts empty."""
+    key_hi = np.asarray(key_hi, np.uint32)
+    key_lo = np.asarray(key_lo, np.uint32)
+    val_hi = np.asarray(val_hi, np.uint32)
+    val_lo = np.asarray(val_lo, np.uint32)
+    n = len(key_hi)
+    if capacity < n or capacity & (capacity - 1):
+        raise ValueError(f"capacity {capacity} cannot hold {n} entries")
+    order = np.lexsort((key_lo, key_hi))
+    planes = []
+    for a in (key_hi[order], key_lo[order], val_hi[order], val_lo[order]):
+        out = np.zeros(capacity, np.uint32)
+        out[:n] = a
+        planes.append(xp.asarray(out))
+    dc = _delta_cap(capacity)
+    zd = xp.zeros((dc,), dtype=xp.uint32)
+    return DeltaSet(
+        *planes, zd, zd, zd, zd,
+        xp.asarray(n, dtype=xp.int32), xp.asarray(0, dtype=xp.int32),
+    )
+
+
+def _bsearch_member(key_hi, key_lo, n, q_hi, q_lo):
+    """Branchless lower-bound membership of (q_hi, q_lo) batches in the
+    sorted prefix ``[:n]`` of the key planes."""
+    import jax.numpy as jnp
+
+    cap = key_hi.shape[0]
+    off = jnp.zeros(q_hi.shape, jnp.int32)
+    step = cap
+    while step > 1:
+        step //= 2
+        mid = off + step
+        kh = key_hi[mid - 1]
+        kl = key_lo[mid - 1]
+        less = (kh < q_hi) | ((kh == q_hi) & (kl < q_lo))
+        off = jnp.where((mid <= n) & less, mid, off)
+    at = jnp.minimum(off, cap - 1)
+    return (off < n) & (key_hi[at] == q_hi) & (key_lo[at] == q_lo), at
+
+
+def insert(
+    ds: DeltaSet,
+    fp_hi,
+    fp_lo,
+    val_hi,
+    val_lo,
+    active,
+    *,
+    max_probes: int = 0,  # signature compatibility; unused
+) -> Tuple[DeltaSet, "jax.Array", "jax.Array"]:
+    """Same contract as ``sortedset.insert``: ``is_new`` in original batch
+    order (lowest-index winner among in-batch duplicates of keys in
+    neither tier); winners' values stored; ``overflow`` True only when even
+    a flush cannot fit the merged set in main (the caller grows and
+    retries; the returned set is then invalid)."""
+    import jax
+    import jax.numpy as jnp
+
+    C = ds.main_capacity
+    Dc = ds.delta_capacity
+    m = fp_hi.shape[0]
+    full = jnp.uint32(0xFFFFFFFF)
+
+    # --- shared prologue: candidate sort + membership + winner election --
+    kh = jnp.where(active, fp_hi, full)
+    kl = jnp.where(active, fp_lo, full)
+    ticket = jnp.arange(m, dtype=jnp.int32)
+    skh, skl, st = jax.lax.sort((kh, kl, ticket), num_keys=3)
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), (skh[1:] != skh[:-1]) | (skl[1:] != skl[:-1])]
+    )
+    real = ~((skh == full) & (skl == full))
+    # Membership probes run on the SORTED batch: ascending access pattern.
+    in_main, _ = _bsearch_member(ds.main_key_hi, ds.main_key_lo, ds.n_main, skh, skl)
+    in_delta, _ = _bsearch_member(
+        ds.delta_key_hi, ds.delta_key_lo, ds.n_delta, skh, skl
+    )
+    winner = run_start & real & ~in_main & ~in_delta
+    n_win = jnp.sum(winner, dtype=jnp.int32)
+
+    # is_new back to batch order: inverse permutation by one sort.
+    _, winner_in_order = jax.lax.sort((st, winner.astype(jnp.int32)), num_keys=1)
+    is_new = winner_in_order.astype(jnp.bool_)
+
+    # Winner values, aligned with the sorted batch.
+    vh = val_hi[st]
+    vl = val_lo[st]
+
+    new_total_delta = ds.n_delta + n_win
+    need_flush = new_total_delta > Dc
+    # Overflow only on a flush that cannot fit main (the proactive growth
+    # rule at 3/4 of total capacity fires first for Dc = C/16, so this is
+    # a tiny-table / adversarial-batch safety net).
+    overflow = need_flush & (ds.n_main + new_total_delta > C)
+
+    def delta_path(_):
+        # Merge winners into the delta tier: one sort of [Dc + m].
+        dkh = jnp.concatenate(
+            [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_hi, full),
+             jnp.where(winner, skh, full)]
+        )
+        dkl = jnp.concatenate(
+            [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_lo, full),
+             jnp.where(winner, skl, full)]
+        )
+        dvh = jnp.concatenate([ds.delta_val_hi, jnp.where(winner, vh, 0)])
+        dvl = jnp.concatenate([ds.delta_val_lo, jnp.where(winner, vl, 0)])
+        mkh, mkl, mvh, mvl = jax.lax.sort((dkh, dkl, dvh, dvl), num_keys=2)
+        row_ok = jnp.arange(Dc) < jnp.minimum(new_total_delta, Dc)
+        z = jnp.uint32(0)
+        return (
+            ds.main_key_hi, ds.main_key_lo, ds.main_val_hi, ds.main_val_lo,
+            jnp.where(row_ok, mkh[:Dc], z),
+            jnp.where(row_ok, mkl[:Dc], z),
+            jnp.where(row_ok, mvh[:Dc], z),
+            jnp.where(row_ok, mvl[:Dc], z),
+            ds.n_main,
+            jnp.minimum(new_total_delta, Dc),
+        )
+
+    def flush_path(_):
+        # Fold main + delta + batch winners into main: sort [C + Dc + m].
+        mk_valid = jnp.arange(C) < ds.n_main
+        dk_valid = jnp.arange(Dc) < ds.n_delta
+        akh = jnp.concatenate(
+            [jnp.where(mk_valid, ds.main_key_hi, full),
+             jnp.where(dk_valid, ds.delta_key_hi, full),
+             jnp.where(winner, skh, full)]
+        )
+        akl = jnp.concatenate(
+            [jnp.where(mk_valid, ds.main_key_lo, full),
+             jnp.where(dk_valid, ds.delta_key_lo, full),
+             jnp.where(winner, skl, full)]
+        )
+        avh = jnp.concatenate(
+            [ds.main_val_hi, ds.delta_val_hi, jnp.where(winner, vh, 0)]
+        )
+        avl = jnp.concatenate(
+            [ds.main_val_lo, ds.delta_val_lo, jnp.where(winner, vl, 0)]
+        )
+        mkh, mkl, mvh, mvl = jax.lax.sort((akh, akl, avh, avl), num_keys=2)
+        n_new_main = ds.n_main + new_total_delta
+        row_ok = jnp.arange(C) < jnp.minimum(n_new_main, C)
+        z = jnp.uint32(0)
+        zd = jnp.zeros((Dc,), jnp.uint32)
+        return (
+            jnp.where(row_ok, mkh[:C], z),
+            jnp.where(row_ok, mkl[:C], z),
+            jnp.where(row_ok, mvh[:C], z),
+            jnp.where(row_ok, mvl[:C], z),
+            zd, zd, zd, zd,
+            jnp.minimum(n_new_main, C),
+            jnp.asarray(0, jnp.int32),
+        )
+
+    outs = jax.lax.cond(need_flush, flush_path, delta_path, operand=None)
+    return DeltaSet(*outs), is_new, overflow
+
+
+def lookup(ds: DeltaSet, fp_hi, fp_lo, *, max_probes: int = 0):
+    """Batched membership + value lookup across both tiers."""
+    import jax.numpy as jnp
+
+    hit_m, at_m = _bsearch_member(ds.main_key_hi, ds.main_key_lo, ds.n_main, fp_hi, fp_lo)
+    hit_d, at_d = _bsearch_member(
+        ds.delta_key_hi, ds.delta_key_lo, ds.n_delta, fp_hi, fp_lo
+    )
+    z = jnp.uint32(0)
+    vh = jnp.where(
+        hit_m, ds.main_val_hi[at_m], jnp.where(hit_d, ds.delta_val_hi[at_d], z)
+    )
+    vl = jnp.where(
+        hit_m, ds.main_val_lo[at_m], jnp.where(hit_d, ds.delta_val_lo[at_d], z)
+    )
+    return hit_m | hit_d, vh, vl
+
+
+def grow(ds: DeltaSet, new_capacity: int, xp) -> DeltaSet:
+    """Grow the main tier (plane copy) and rescale the delta tier,
+    folding any delta contents into main so tier invariants hold."""
+    if new_capacity < ds.main_capacity:
+        raise ValueError("delta set cannot shrink")
+    # Host-side: materialize occupied rows of both tiers, rebuild. The
+    # minimum delta tier (1024 rows) can out-hold a tiny main, so size the
+    # new main for the actual occupancy, not just the caller's doubling.
+    kh = np.asarray(ds.key_hi)
+    kl = np.asarray(ds.key_lo)
+    vh = np.asarray(ds.val_hi)
+    vl = np.asarray(ds.val_lo)
+    occ = (kh != 0) | (kl != 0)
+    n = int(occ.sum())
+    while new_capacity < 2 * n:
+        new_capacity *= 2
+    return from_entries(kh[occ], kl[occ], vh[occ], vl[occ], new_capacity, xp)

@@ -70,7 +70,7 @@ import numpy as np
 from .checker.base import Checker
 from .checker.path import Path
 from .core import Expectation, Model
-from .ops import fphash, hashset, sortedset
+from .ops import deltaset, fphash, hashset, sortedset
 
 
 #: The PackedModel protocol surface (module docstring above).
@@ -175,10 +175,12 @@ class XlaChecker(Checker):
         # that wins there.
         if dedup == "auto":
             dedup = "hash" if jax.default_backend() == "cpu" else "sorted"
-        if dedup not in ("hash", "sorted"):
-            raise ValueError(f"dedup must be 'auto', 'hash', or 'sorted': {dedup!r}")
+        if dedup not in ("hash", "sorted", "delta"):
+            raise ValueError(
+                f"dedup must be 'auto', 'hash', 'sorted', or 'delta': {dedup!r}"
+            )
         self._dedup = dedup
-        self._ds = sortedset if dedup == "sorted" else hashset
+        self._ds = {"hash": hashset, "sorted": sortedset, "delta": deltaset}[dedup]
         # Structure-of-arrays state layout rides with the sorted (accelerator)
         # structure: XLA:TPU tiles the minor two dims of every buffer to
         # (8, 128), so a [N, W] row-major frontier with W=2 pads 2 lanes to
@@ -187,7 +189,7 @@ class XlaChecker(Checker):
         # the 128-lane axis. The planes superstep preserves the rows
         # superstep's semantics bit-for-bit (candidates are restored to
         # state-major order before the insert's winner election).
-        self._soa = dedup == "sorted"
+        self._soa = dedup != "hash"
         # Planes-compaction lowering: "gather" computes the permutation
         # once (one small sort) and gathers every plane by it; "sort"
         # carries the planes as sort payload operands — no random gathers,
@@ -337,11 +339,13 @@ class XlaChecker(Checker):
         validate_model(ck["meta"], self._model, self._prop_names)
 
         n_entries = len(ck["key_hi"])
-        cap = self._table.capacity
+        # Power-of-two growth base: the delta structure's .capacity includes
+        # its delta tier (not a power of two); its main tier is the base.
+        cap = getattr(self._table, "main_capacity", self._table.capacity)
         while cap < 2 * n_entries:
             cap *= 2
-        if self._dedup == "sorted":
-            self._table = sortedset.from_entries(
+        if self._dedup in ("sorted", "delta"):
+            self._table = self._ds.from_entries(
                 ck["key_hi"], ck["key_lo"], ck["val_hi"], ck["val_lo"], cap, jnp
             )
         else:
@@ -1122,9 +1126,9 @@ class XlaChecker(Checker):
         structure's load ceiling — BEFORE inserts start paying (hash: long
         probe chains; sorted: an overflow-retry round trip)."""
         num, den = (
-            (self.SORTED_LOAD_NUM, self.SORTED_LOAD_DEN)
-            if self._dedup == "sorted"
-            else (self.MAX_LOAD_NUM, self.MAX_LOAD_DEN)
+            (self.MAX_LOAD_NUM, self.MAX_LOAD_DEN)
+            if self._dedup == "hash"
+            else (self.SORTED_LOAD_NUM, self.SORTED_LOAD_DEN)
         )
         while self._unique_count * den > self._table.capacity * num:
             self._grow_table()
@@ -1137,7 +1141,11 @@ class XlaChecker(Checker):
         import jax.numpy as jnp
 
         old = self._table
-        if self._dedup == "sorted":
+        if self._dedup == "delta":
+            # Growth folds the delta into a doubled main tier (host-side
+            # rebuild; rare by the load rule).
+            self._table = deltaset.grow(old, old.main_capacity * 2, jnp)
+        elif self._dedup == "sorted":
             self._table = sortedset.grow(old, old.capacity * 2, jnp)
         else:
             occupied = (old.key_hi != 0) | (old.key_lo != 0)

@@ -1,0 +1,140 @@
+"""The two-tier delta visited set (ops/deltaset.py): op-level differential
+parity against the flat sorted set and the hash set, flush behavior, tier
+invariants, and engine-level parity of ``spawn_xla(dedup="delta")``.
+
+The delta structure exists for soak-scale tables (per-level cost bounded
+by the delta tier + binary search instead of a full-capacity sort); its
+contract is identical, so every test here is an equality test.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from stateright_tpu.ops import deltaset, hashset, sortedset
+
+
+def _rand_batch(rng, m, universe):
+    hi = jnp.asarray(rng.integers(1, universe, m, dtype=np.uint32))
+    lo = jnp.asarray(rng.integers(1, universe, m, dtype=np.uint32))
+    vh = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+    vl = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+    act = jnp.asarray(rng.integers(0, 2, m).astype(bool))
+    return hi, lo, vh, vl, act
+
+
+@pytest.mark.parametrize("universe", [40, 2**31])  # heavy duplicates / near-unique
+def test_insert_lookup_differential_vs_other_structures(universe):
+    rng = np.random.default_rng(11)
+    dl = deltaset.make(1 << 11, jnp)
+    ss = sortedset.make(1 << 12, jnp)
+    hs = hashset.make(1 << 13, jnp)
+    for rnd in range(10):
+        hi, lo, vh, vl, act = _rand_batch(rng, 257, universe)
+        dl, d_new, d_ovf = deltaset.insert(dl, hi, lo, vh, vl, act)
+        ss, s_new, s_ovf = sortedset.insert(ss, hi, lo, vh, vl, act)
+        hs, h_new, h_ovf = hashset.insert(hs, hi, lo, vh, vl, act)
+        assert np.array_equal(np.asarray(d_new), np.asarray(s_new)), rnd
+        assert np.array_equal(np.asarray(d_new), np.asarray(h_new)), rnd
+        assert not bool(d_ovf) and not bool(s_ovf)
+        qh = jnp.asarray(rng.integers(1, min(universe + 20, 2**32 - 1), 128, dtype=np.uint32))
+        ql = jnp.asarray(rng.integers(1, min(universe + 20, 2**32 - 1), 128, dtype=np.uint32))
+        for a, b in zip(deltaset.lookup(dl, qh, ql), sortedset.lookup(ss, qh, ql)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), rnd
+
+
+def test_flush_fires_and_preserves_membership():
+    """Batches sized to overflow the delta tier force the in-kernel flush;
+    every inserted key must remain a member and tier invariants hold."""
+    rng = np.random.default_rng(5)
+    # main 2^12 -> delta tier 1024: two 700-unique batches must flush.
+    dl = deltaset.make(1 << 12, jnp)
+    seen = set()
+    for rnd in range(4):
+        hi, lo, vh, vl, act = _rand_batch(rng, 700, 2**31)
+        dl, is_new, ovf = deltaset.insert(dl, hi, lo, vh, vl, act)
+        assert not bool(ovf)
+        a = np.asarray(act)
+        for h, l, keep in zip(np.asarray(hi), np.asarray(lo), a):
+            if keep:
+                seen.add((int(h), int(l)))
+    assert int(dl.n_main) > 0, "flush never fired"
+    # Tier invariants: sorted unique prefixes, zero pads, disjoint tiers.
+    for kh_p, kl_p, n in (
+        (dl.main_key_hi, dl.main_key_lo, int(dl.n_main)),
+        (dl.delta_key_hi, dl.delta_key_lo, int(dl.n_delta)),
+    ):
+        kh = np.asarray(kh_p)
+        kl = np.asarray(kl_p)
+        keys = (kh[:n].astype(np.uint64) << 32) | kl[:n]
+        assert np.all(keys[1:] > keys[:-1])
+        assert not np.any(kh[n:]) and not np.any(kl[n:])
+    assert int(dl.n_main) + int(dl.n_delta) == len(seen)
+    qh = jnp.asarray(np.asarray([k[0] for k in seen], np.uint32))
+    ql = jnp.asarray(np.asarray([k[1] for k in seen], np.uint32))
+    found, _, _ = deltaset.lookup(dl, qh, ql)
+    assert bool(jnp.all(found))
+
+
+def test_grow_rebuilds_both_tiers():
+    rng = np.random.default_rng(7)
+    dl = deltaset.make(1 << 11, jnp)
+    hi, lo, vh, vl, act = _rand_batch(rng, 500, 2**31)
+    dl, _, _ = deltaset.insert(dl, hi, lo, vh, vl, act)
+    n_before = int(dl.n_main) + int(dl.n_delta)
+    grown = deltaset.grow(dl, 1 << 13, jnp)
+    assert grown.main_capacity == 1 << 13
+    assert int(grown.n_main) == n_before and int(grown.n_delta) == 0
+    found, gvh, gvl = deltaset.lookup(
+        grown, jnp.where(act, hi, 1), jnp.where(act, lo, 1)
+    )
+    # every active key is a member of the grown set
+    assert bool(jnp.all(jnp.where(act, found, True)))
+
+
+def _counts(c):
+    return (c.state_count(), c.unique_state_count(), c.max_depth())
+
+
+def test_engine_parity_dedup_delta():
+    """spawn_xla(dedup="delta") reproduces the sorted engine's counts and
+    witness paths, including through in-kernel flushes (small tiers)."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    kw = dict(frontier_capacity=1 << 6, table_capacity=1 << 10)
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="sorted", **kw).join()
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="delta", **kw).join()
+    assert _counts(a) == _counts(b)
+    assert b.unique_state_count() == 288
+    da, db = a.discoveries(), b.discoveries()
+    assert set(da) == set(db) and da
+    for name in da:
+        assert da[name].into_states() == db[name].into_states()
+
+
+def test_engine_parity_delta_under_forced_growth():
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    kw = dict(frontier_capacity=1 << 6, table_capacity=1 << 7)
+    a = PackedTwoPhaseSys(4).checker().spawn_xla(dedup="hash", **kw).join()
+    b = PackedTwoPhaseSys(4).checker().spawn_xla(dedup="delta", **kw).join()
+    assert _counts(a) == _counts(b)
+    assert b.unique_state_count() == 1_568
+
+
+def test_checkpoint_crosses_into_delta(tmp_path):
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    path = str(tmp_path / "ck.npz")
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(
+        dedup="sorted", levels_per_dispatch=1
+    )
+    for _ in range(4):
+        a._run_block()
+    a.save_checkpoint(path)
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(
+        dedup="delta", checkpoint=path
+    ).join()
+    full = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="delta").join()
+    assert _counts(b) == _counts(full) == (1146, 288, 11)

@@ -168,20 +168,25 @@ def main() -> None:
     # (insert-values + is_new routing via STPU_SORTEDSET_VALUES, planes
     # compaction via spawn_xla(compaction=); fresh model instances so the
     # in-process superstep cache cannot mix lowerings.)
-    for values_via, comp in (("gather", "gather"), ("sort", "sort")):
+    for dedup, values_via, comp in (
+        ("sorted", "gather", "gather"),
+        ("sorted", "sort", "sort"),
+        ("delta", "gather", "gather"),
+        ("delta", "gather", "sort"),
+    ):
         sortedset.VALUES_VIA = values_via
         m3 = PackedTwoPhaseSys(rm)
         kw = dict(frontier_capacity=1 << 19, table_capacity=table_cap,
-                  dedup="sorted", compaction=comp)
+                  dedup=dedup, compaction=comp)
         t0 = time.monotonic()
         m3.checker().spawn_xla(**kw).join()
         warm = time.monotonic() - t0
         t0 = time.monotonic()
         ck = m3.checker().spawn_xla(**kw).join()
         dt = time.monotonic() - t0
-        print(f"A/B values={values_via} compaction={comp}: warm {warm:6.1f}s "
-              f"measured {dt:6.2f}s ({ck.state_count()/dt/1e6:6.2f} M gen/s)",
-              flush=True)
+        print(f"A/B dedup={dedup} values={values_via} compaction={comp}: "
+              f"warm {warm:6.1f}s measured {dt:6.2f}s "
+              f"({ck.state_count()/dt/1e6:6.2f} M gen/s)", flush=True)
     sortedset.VALUES_VIA = "gather"
 
 
