@@ -138,3 +138,11 @@ def test_checkpoint_crosses_into_delta(tmp_path):
     ).join()
     full = PackedTwoPhaseSys(3).checker().spawn_xla(dedup="delta").join()
     assert _counts(b) == _counts(full) == (1146, 288, 11)
+
+
+def test_engine_parity_delta_symmetry():
+    from stateright_tpu.models.increment import PackedIncrement
+
+    a = PackedIncrement(3).checker().symmetry().spawn_xla(dedup="sorted").join()
+    b = PackedIncrement(3).checker().symmetry().spawn_xla(dedup="delta").join()
+    assert _counts(a) == _counts(b) == (27, 17, 5)
