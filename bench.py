@@ -248,6 +248,10 @@ def _worker(platform: str) -> None:
     spawn_kwargs = dict(
         frontier_capacity=1 << frontier_pow, table_capacity=1 << table_pow
     )
+    # Visited-set structure override (the on-chip A/B: sorted vs delta);
+    # default "auto" = hash on CPU, sorted on accelerators.
+    if os.environ.get("BENCH_DEDUP"):
+        spawn_kwargs["dedup"] = os.environ["BENCH_DEDUP"]
     warm_states, warm_sec, _, _ = _run_check(
         model, None, budget_s=warm_budget, **spawn_kwargs
     )

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..checker.base import Checker
 from ..core import Expectation, Model
-from ..ops import fphash, hashset, sortedset
+from ..ops import deltaset, fphash, hashset, sortedset
 from ..xla import XlaChecker, _require_packed
 
 # Owner mix constants: decorrelated from both the fingerprint lanes and the
@@ -131,10 +131,12 @@ class ShardedXlaChecker(Checker):
         # dedup races impossible either way).
         if dedup == "auto":
             dedup = "hash" if jax.default_backend() == "cpu" else "sorted"
-        if dedup not in ("hash", "sorted"):
-            raise ValueError(f"dedup must be 'auto', 'hash', or 'sorted': {dedup!r}")
+        if dedup not in ("hash", "sorted", "delta"):
+            raise ValueError(
+                f"dedup must be 'auto', 'hash', 'sorted', or 'delta': {dedup!r}"
+            )
         self._dedup = dedup
-        self._ds = sortedset if dedup == "sorted" else hashset
+        self._ds = {"hash": hashset, "sorted": sortedset, "delta": deltaset}[dedup]
 
         D = self._D
         # Capacities learned by earlier checkers of this model over a
@@ -306,6 +308,10 @@ class ShardedXlaChecker(Checker):
     # layout contract, so checkpointing and the native ParentMap consume
     # either unchanged.
 
+    def _delta_cap(self) -> int:
+        """Per-shard delta-tier rows for dedup="delta"."""
+        return deltaset._delta_cap(self._Cl)
+
     def _make_table(self):
         import jax
         import jax.numpy as jnp
@@ -313,17 +319,26 @@ class ShardedXlaChecker(Checker):
         D = self._D
         z = jnp.zeros((D * self._Cl,), jnp.uint32)
         planes = [jax.device_put(z, self._plane_sharding) for _ in range(4)]
+        if self._dedup == "delta":
+            zd = jnp.zeros((D * self._delta_cap(),), jnp.uint32)
+            dplanes = [jax.device_put(zd, self._plane_sharding) for _ in range(4)]
+            nz = lambda: jax.device_put(
+                jnp.zeros((D,), jnp.int32), self._plane_sharding
+            )
+            return deltaset.DeltaSet(*planes, *dplanes, nz(), nz())
         if self._dedup == "sorted":
             n = jax.device_put(jnp.zeros((D,), jnp.int32), self._plane_sharding)
             return sortedset.SortedSet(*planes, n)
         return hashset.HashSet(*planes)
 
     def _table_len(self) -> int:
-        return 5 if self._dedup == "sorted" else 4
+        return {"hash": 4, "sorted": 5, "delta": 10}[self._dedup]
 
     def _local_table(self, table):
         """Per-shard structure from the shard-local plane blocks (inside
-        shard_map: planes are [Cl], the n plane is [1])."""
+        shard_map: planes are [Cl] (+ [dc] delta tiers), n planes [1])."""
+        if self._dedup == "delta":
+            return deltaset.DeltaSet(*table[:8], table[8][0], table[9][0])
         if self._dedup == "sorted":
             return sortedset.SortedSet(
                 table[0], table[1], table[2], table[3], table[4][0]
@@ -333,6 +348,11 @@ class ShardedXlaChecker(Checker):
     @staticmethod
     def _local_table_out(new_table):
         """Back to the tuple-of-blocks form (rank-1 n so it shards)."""
+        if isinstance(new_table, deltaset.DeltaSet):
+            return tuple(new_table[:8]) + (
+                new_table.n_main[None],
+                new_table.n_delta[None],
+            )
         if isinstance(new_table, sortedset.SortedSet):
             return (
                 new_table.key_hi,
@@ -480,7 +500,11 @@ class ShardedXlaChecker(Checker):
             return int(np.asarray(unique))
 
     def _global_table(self, planes):
-        cls = sortedset.SortedSet if self._dedup == "sorted" else hashset.HashSet
+        cls = {
+            "hash": hashset.HashSet,
+            "sorted": sortedset.SortedSet,
+            "delta": deltaset.DeltaSet,
+        }[self._dedup]
         return cls(*planes)
 
     def _make_local_step(self, Fl: int, Cl: int, K: int):
@@ -499,7 +523,7 @@ class ShardedXlaChecker(Checker):
         max_probes = self._max_probes
         LANES = W + 5  # state words + fp_hi, fp_lo, par_hi, par_lo, ebits
         ds = self._ds
-        sorted_mode = self._dedup == "sorted"
+        sorted_mode = self._dedup != "hash"  # planes/gather lowering family
         local_table = self._local_table
         local_table_out = self._local_table_out
 
@@ -968,6 +992,55 @@ class ShardedXlaChecker(Checker):
         old = self._table
         new_Cl = Cl * 2
         max_probes = self._max_probes
+
+        if self._dedup == "delta":
+            dc = self._delta_cap()
+            # The minimum delta tier (1024) can out-hold a tiny main
+            # partition: the doubled main must fit main + delta.
+            new_Cl = 2 * max(Cl, dc)
+            new_dc = deltaset._delta_cap(new_Cl)
+
+            def grow_delta_local(planes):
+                # Fold delta into a doubled main, shard-locally: one sort
+                # of [Cl + dc] (tiers are disjoint, so merged keys are
+                # unique); the delta tier resets at its rescaled size.
+                mkh, mkl, mvh, mvl, dkh, dkl, dvh, dvl, nm, nd = planes
+                full = jnp.uint32(0xFFFFFFFF)
+                m_valid = jnp.arange(Cl) < nm[0]
+                d_valid = jnp.arange(dc) < nd[0]
+                kh = jnp.concatenate(
+                    [jnp.where(m_valid, mkh, full), jnp.where(d_valid, dkh, full)]
+                )
+                kl = jnp.concatenate(
+                    [jnp.where(m_valid, mkl, full), jnp.where(d_valid, dkl, full)]
+                )
+                vh = jnp.concatenate([mvh, dvh])
+                vl = jnp.concatenate([mvl, dvl])
+                skh, skl, svh, svl = jax.lax.sort((kh, kl, vh, vl), num_keys=2)
+                n_new = nm[0] + nd[0]
+                row_ok = jnp.arange(Cl + dc) < n_new
+                z = jnp.uint32(0)
+                pad = jnp.zeros((new_Cl - Cl - dc,), jnp.uint32)
+                out = lambda a: jnp.concatenate([jnp.where(row_ok, a, z), pad])
+                zd = jnp.zeros((new_dc,), jnp.uint32)
+                return (
+                    out(skh), out(skl), out(svh), out(svl),
+                    zd, zd, zd, zd,
+                    n_new[None], jnp.zeros((1,), jnp.int32),
+                )
+
+            fn = self._shard_map(
+                grow_delta_local,
+                in_specs=((P("shards"),) * 10,),
+                out_specs=(P("shards"),) * 10,
+            )
+            planes = fn(tuple(self._table))
+            self._table = deltaset.DeltaSet(
+                *planes[:8], *(p.reshape(-1) for p in planes[8:])
+            )
+            self._Cl = new_Cl
+            self._cap_hints()["table"] = D * new_Cl
+            return
 
         if self._dedup == "sorted":
 

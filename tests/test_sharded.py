@@ -240,3 +240,32 @@ def test_sharded_sorted_capacity_autogrowth():
         keys = (kh[d, :n].astype(np.uint64) << 32) | kl[d, :n]
         assert np.all(keys[1:] > keys[:-1]), f"shard {d} prefix not sorted"
         assert not np.any(kh[d, n:]) and not np.any(kl[d, n:])
+
+
+def test_sharded_delta_dedup_matches_sorted():
+    """Per-shard two-tier delta tables (dedup="delta") on the mesh:
+    counts, witness paths, and the in-kernel flush all must reproduce the
+    sorted engine exactly (tiny tiers force flushes and a growth)."""
+    kw = dict(mesh=_mesh(), frontier_capacity=1 << 10)
+    a = (
+        PackedTwoPhaseSys(4)
+        .checker()
+        .spawn_xla(dedup="sorted", table_capacity=1 << 13, **kw)
+        .join()
+    )
+    b = (
+        PackedTwoPhaseSys(4)
+        .checker()
+        .spawn_xla(dedup="delta", table_capacity=1 << 10, **kw)
+        .join()
+    )
+    assert (a.state_count(), a.unique_state_count(), a.max_depth()) == (
+        b.state_count(),
+        b.unique_state_count(),
+        b.max_depth(),
+    )
+    assert b.unique_state_count() == 1_568
+    da, db = a.discoveries(), b.discoveries()
+    assert set(da) == set(db) and da
+    for name in da:
+        assert da[name].into_states() == db[name].into_states()
