@@ -51,6 +51,15 @@ def graph_batch(seed0: int, n: int) -> int:
         srt = PackedDGraph(g).checker().spawn_xla(dedup="sorted", **KW).join()
         got = (srt.state_count(), srt.unique_state_count(), srt.max_depth())
         assert got == expect, f"seed {seed}: xla-sorted {got} != oracle {expect}"
+        # Tiny table so the two-tier structure flushes constantly.
+        dlt = (
+            PackedDGraph(g)
+            .checker()
+            .spawn_xla(dedup="delta", frontier_capacity=1 << 10, table_capacity=1 << 11)
+            .join()
+        )
+        got = (dlt.state_count(), dlt.unique_state_count(), dlt.max_depth())
+        assert got == expect, f"seed {seed}: xla-delta {got} != oracle {expect}"
         if mesh is not None and seed % 4 == 0:
             sh = PackedDGraph(g).checker().spawn_xla(mesh=mesh, **KW).join()
             got = (sh.state_count(), sh.unique_state_count(), sh.max_depth())
