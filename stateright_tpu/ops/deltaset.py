@@ -93,10 +93,13 @@ class DeltaSet(NamedTuple):
 
 #: Delta-tier rows as a fraction of main capacity (1/2**DELTA_SHIFT).
 DELTA_SHIFT = 4
+#: Floor on delta-tier rows. Module-level so tests/soaks can shrink it to
+#: force the flush path on tiny state spaces (trace-time constant).
+MIN_DELTA = 1024
 
 
 def _delta_cap(capacity: int) -> int:
-    return max(capacity >> DELTA_SHIFT, 1024)
+    return max(capacity >> DELTA_SHIFT, MIN_DELTA)
 
 
 def make(capacity: int, xp) -> DeltaSet:

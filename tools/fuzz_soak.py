@@ -1,8 +1,8 @@
 """Extended randomized differential soak (a driver, not a test).
 
 Runs the suite's differential-fuzz logic at many more seeds for a
-wall-clock budget: random graphs across all five engines (exact count
-agreement), plus device-serializer fuzz vs the host backtracking testers
+wall-clock budget: random graphs across all engine configurations (exact
+count agreement), plus device-serializer fuzz vs the host backtracking testers
 at several (threads, ops, spec, consistency) shapes. Any disagreement is a
 real bug; the run prints one PASS/FAIL line per batch and a final summary.
 
@@ -51,13 +51,21 @@ def graph_batch(seed0: int, n: int) -> int:
         srt = PackedDGraph(g).checker().spawn_xla(dedup="sorted", **KW).join()
         got = (srt.state_count(), srt.unique_state_count(), srt.max_depth())
         assert got == expect, f"seed {seed}: xla-sorted {got} != oracle {expect}"
-        # Tiny table so the two-tier structure flushes constantly.
-        dlt = (
-            PackedDGraph(g)
-            .checker()
-            .spawn_xla(dedup="delta", frontier_capacity=1 << 10, table_capacity=1 << 11)
-            .join()
-        )
+        # A tiny delta tier (MIN_DELTA=4) forces the in-kernel flush path
+        # on nearly every level even for these small graphs.
+        from stateright_tpu.ops import deltaset
+
+        saved_min = deltaset.MIN_DELTA
+        deltaset.MIN_DELTA = 4
+        try:
+            dlt = (
+                PackedDGraph(g)
+                .checker()
+                .spawn_xla(dedup="delta", **dict(KW, table_capacity=1 << 11))
+                .join()
+            )
+        finally:
+            deltaset.MIN_DELTA = saved_min
         got = (dlt.state_count(), dlt.unique_state_count(), dlt.max_depth())
         assert got == expect, f"seed {seed}: xla-delta {got} != oracle {expect}"
         if mesh is not None and seed % 4 == 0:
@@ -173,7 +181,7 @@ def main() -> None:
             flush=True,
         )
     print(
-        f"[fuzz_soak] DONE: {graphs} random graphs x 5 engines and {sems} "
+        f"[fuzz_soak] DONE: {graphs} random graphs x 6 engine configs and {sems} "
         f"random histories x device-vs-host serializers, zero disagreements "
         f"in {time.monotonic()-t0:.0f}s",
         flush=True,
