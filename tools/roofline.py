@@ -67,7 +67,7 @@ def main() -> None:
     )
     print(
         f"logical traffic {total_bytes/1e9:.1f} GB -> achieved "
-        f"{gbps:.0f} GB/s logical ({100*gbps/PEAK_GBPS:.0f}% of v5e peak; "
+        f"{gbps:.2f} GB/s logical ({100*gbps/PEAK_GBPS:.2f}% of v5e peak; "
         "sort stages move data ~log-n passes, so >15-25% logical is "
         "already traffic-bound)"
     )
