@@ -3,13 +3,14 @@
 # can be short — the committed primary artifact comes before diagnostics):
 #   1. layout probe        (fast; validates the plane-major design on-chip)
 #   2. bench.py            (the primary metric, now on the planes engine)
-#   3. superstep profile   (per-stage accounting for the next optimization)
-# Logs -> tpu_watch_r3b.log, tpu_layout_probe.log, bench_probe.log, tpu_profile.log
+#   3. superstep profile   (per-stage accounting + dedup/lowering A/B)
+# then COMMITS the artifacts (the session may have ended by then; a
+# measurement that is not in git did not happen).
 set -u
 cd "$(dirname "$0")/.."
 LOG=tpu_watch_r3b.log
 log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
-log "watcher started (pid $$)"
+log "watcher restarted (pid $$)"
 while true; do
   if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
     log "TUNNEL UP — layout probe"
@@ -17,13 +18,16 @@ while true; do
     rc1=$?
     log "layout_probe rc=$rc1"
     log "bench.py (primary)"
-    timeout 3000 python bench.py >bench_r3b.json.tmp 2>>"$LOG"
+    timeout 3000 python bench.py >bench_r3b_out.json 2>>"$LOG"
     rc2=$?
-    log "bench rc=$rc2: $(tail -c 300 bench_r3b.json.tmp 2>/dev/null)"
+    log "bench rc=$rc2: $(tail -c 300 bench_r3b_out.json 2>/dev/null)"
     log "superstep profile"
-    timeout 2400 python tools/profile_superstep.py 8 >tpu_profile.log 2>&1
+    timeout 2700 python tools/profile_superstep.py 8 >tpu_profile.log 2>&1
     rc3=$?
     log "profile_superstep rc=$rc3"
+    git add -A >>"$LOG" 2>&1
+    git commit -q -m "TPU window artifacts: layout probe (rc=$rc1), bench (rc=$rc2), superstep profile + A/B (rc=$rc3)" >>"$LOG" 2>&1
+    log "artifacts committed"
     if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]; then
       log "all stages done; watcher exiting"
       exit 0
