@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Round-4 tunnel watcher. On recovery, in priority order (tunnel windows
+# can be short — the committed primary artifact comes before diagnostics):
+#   1. layout probe        (fast; validates the plane-major design on-chip)
+#   2. bench.py            (the primary metric, count-checked)
+#   3. superstep profile   (per-stage accounting + dedup/lowering A/B)
+# then COMMITS the artifacts (the session may have ended by then; a
+# measurement that is not in git did not happen). Unlike the r3b watcher,
+# this one stages ONLY the files it produced — an unattended `git add -A`
+# would sweep unrelated in-progress working-tree changes into the
+# automated commit (ADVICE.md round-3 item 3).
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch_r4.log
+ARTIFACTS=(tpu_layout_probe.log bench_r4_out.json bench_detail.json \
+           bench_probe.log tpu_profile.log "$LOG")
+log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+log "watcher started (pid $$)"
+while true; do
+  if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
+    log "TUNNEL UP — layout probe"
+    timeout 1200 python tools/layout_probe.py >tpu_layout_probe.log 2>&1
+    rc1=$?
+    log "layout_probe rc=$rc1"
+    log "bench.py (primary)"
+    timeout 3000 python bench.py >bench_r4_out.json 2>>"$LOG"
+    rc2=$?
+    log "bench rc=$rc2: $(tail -c 300 bench_r4_out.json 2>/dev/null)"
+    log "superstep profile"
+    timeout 2700 python tools/profile_superstep.py 8 >tpu_profile.log 2>&1
+    rc3=$?
+    log "profile_superstep rc=$rc3"
+    # -f: bench_detail.json / bench_probe.log are gitignored working files,
+    # but a TPU window's capture of them is an artifact worth committing.
+    git add -f -- "${ARTIFACTS[@]}" >>"$LOG" 2>&1
+    git commit -q -m "TPU window artifacts: layout probe (rc=$rc1), bench (rc=$rc2), superstep profile + A/B (rc=$rc3)" >>"$LOG" 2>&1
+    log "artifacts committed"
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]; then
+      log "all stages done; watcher exiting"
+      exit 0
+    fi
+    log "a stage failed; resuming watch"
+  else
+    log "tunnel down"
+  fi
+  sleep 240
+done
