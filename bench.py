@@ -39,6 +39,57 @@ import time
 NORTH_STAR = 50_000_000.0
 REPO = os.path.dirname(os.path.abspath(__file__))
 
+# Pinned full-coverage (generated, unique) counts. Exact counts are the
+# product guarantee (the reference asserts them in its example tests, e.g.
+# /root/reference/examples/paxos.rs:321, examples/2pc.rs:156-170), so the
+# bench re-asserts them on EVERY platform and emits ``count_ok`` — a drift
+# like round 3's on-chip paxos 17,198-vs-16,668 must fail loudly, not sit
+# in a log. Sources: rm=3/5 from the reference anchors; the rest pinned by
+# this package's host BFS/DFS oracle and re-verified cross-engine
+# (BASELINE.md; tests/test_two_phase_commit.py, tests/test_paxos.py).
+EXPECTED_2PC = {
+    3: (1_146, 288),
+    4: (8_258, 1_568),
+    5: (58_146, 8_832),
+    6: (402_305, 50_816),
+    7: (2_744_706, 296_448),
+    8: (18_507_778, 1_745_408),
+}
+EXPECTED_MATRIX = {
+    "linearizable-register (ABD) 2c/2s packed": (875, 544),
+    "linearizable-register (ABD) 2c/2s ordered packed": (813, 564),
+    "paxos 2c/3s packed": (32_971, 16_668),
+    "single-copy-register 3c/1s packed": (6_778, 4_243),
+    "increment_lock 3t packed": (61, 61),
+}
+
+
+def _count_check(name: str, expected, states: int, unique: int) -> bool | None:
+    """True/False against a pinned (generated, unique) pair; None when the
+    config has no pin. A False is logged CRITICAL — it means the engine's
+    exact-count contract broke on this platform."""
+    if expected is None:
+        return None
+    ok = (states, unique) == tuple(expected)
+    if not ok:
+        _log(
+            f"COUNT DRIFT on {name}: got generated={states} unique={unique}, "
+            f"pinned={expected[0]}/{expected[1]} — exact-count contract "
+            "violated on this platform; see stateright_tpu/audit.py"
+        )
+    return ok
+
+
+def _audit(checker) -> dict:
+    """Host-side duplicate-key audit of the visited set (audit.py); never
+    lets an audit failure take down the bench."""
+    try:
+        from stateright_tpu.audit import audit_table
+
+        return audit_table(checker)
+    except Exception as e:  # pragma: no cover - diagnostic path
+        return {"error": f"{type(e).__name__}: {e}"}
+
 
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
@@ -188,6 +239,13 @@ def _run_matrix(platform: str) -> list:
                     "warm_pass_sec": round(warm, 3),
                     "time_to_full_coverage_sec": round(sec, 3),
                     "states_per_sec": round(states / max(sec, 1e-9), 1),
+                    "count_ok": _count_check(
+                        name,
+                        EXPECTED_MATRIX.get(name),
+                        states,
+                        checker.unique_state_count(),
+                    ),
+                    "audit": _audit(checker),
                 }
             )
             _log(f"matrix {name}: {rows[-1]}")
@@ -221,17 +279,27 @@ def _worker(platform: str) -> None:
 
     rm = int(os.environ.get("BENCH_RM", "8"))
     frontier_pow = int(os.environ.get("BENCH_FRONTIER_POW", "19"))
-    # Sorted-dedup (the accelerator default) pays one [capacity + batch]
-    # sort per level, so oversizing the table costs every level: 2^22 holds
-    # rm=8's 1.74M uniques within the 3/4-load growth rule with no growth
-    # recompiles. (The round-2 hash default was 2^24 — probe chains want
-    # headroom; capacity was nearly free there.)
-    table_pow = int(os.environ.get("BENCH_TABLE_POW", "22"))
+    # The default table size follows the EFFECTIVE dedup structure, because
+    # the two families want opposite sizing: sorted/delta pay one
+    # [capacity + batch] sort per level, so oversizing costs every level —
+    # 2^22 holds rm=8's 1.74M uniques within the 3/4-load growth rule with
+    # no growth recompiles. The hash structure wants probe-chain headroom
+    # under its 1/4-load rule — 2^24 keeps an rm=8 A/B run (BENCH_DEDUP=
+    # hash) from paying a mid-measurement growth recompile at 2^22, which
+    # would skew exactly the hash-vs-sorted comparison the knob exists for.
+    effective_dedup = os.environ.get("BENCH_DEDUP") or (
+        "hash" if platform == "cpu" else "sorted"
+    )
+    default_table_pow = "24" if effective_dedup == "hash" else "22"
+    table_pow = int(os.environ.get("BENCH_TABLE_POW", default_table_pow))
     if platform == "cpu":
         rm = min(rm, int(os.environ.get("BENCH_CPU_RM", "7")))
         frontier_pow = min(frontier_pow, 17)
         table_pow = min(table_pow, 21)
-    _log(f"worker platform={platform} rm={rm} frontier=2^{frontier_pow} table=2^{table_pow}")
+    _log(
+        f"worker platform={platform} rm={rm} frontier=2^{frontier_pow} "
+        f"table=2^{table_pow} dedup={effective_dedup}"
+    )
 
     from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
 
@@ -267,6 +335,17 @@ def _worker(platform: str) -> None:
         f"depth {checker.max_depth()}, {'full' if completed else 'partial'} "
         f"coverage) in {elapsed:.2f}s -> {value:,.0f} states/s"
     )
+    # Exact-count self-check (pure host arithmetic — safe before the
+    # primary print; only full coverage pins the totals). The table AUDIT
+    # is a device-to-host readback of the whole key planes and therefore
+    # runs AFTER the primary line is out: a tunnel wedge mid-transfer must
+    # not take the already-measured number with it.
+    count_ok = (
+        _count_check(f"2pc rm={rm}", EXPECTED_2PC.get(rm), states,
+                     checker.unique_state_count())
+        if completed
+        else None
+    )
 
     # The primary metric line goes out IMMEDIATELY: the matrix below may
     # outlive the parent's watchdog, and a killed worker must not take the
@@ -278,10 +357,23 @@ def _worker(platform: str) -> None:
                 "value": round(value, 1),
                 "unit": "states/sec",
                 "vs_baseline": round(value / NORTH_STAR, 4),
+                "count_ok": count_ok,
             }
         ),
         flush=True,
     )
+
+    # Host-side duplicate-key audit (tri-state like count_ok: an audit
+    # that itself errored reports the error, not a corruption verdict).
+    # The result reaches the driver via bench_detail.json and the logged
+    # line in bench_probe.log.
+    audit = _audit(checker)
+    if "error" in audit:
+        _log(f"table audit ERRORED (no verdict): {audit}")
+    elif not audit.get("ok", False):
+        _log(f"TABLE AUDIT FAILED: {audit}")
+    else:
+        _log(f"table audit: {audit}")
 
     def write_detail(matrix):
         with open(os.path.join(REPO, "bench_detail.json"), "w") as fh:
@@ -296,6 +388,8 @@ def _worker(platform: str) -> None:
                     "measured_sec": round(elapsed, 3),
                     "full_coverage": completed,
                     "states_per_sec": round(value, 1),
+                    "count_ok": count_ok,
+                    "audit": audit,
                     "levels": detail,
                     "matrix": matrix,
                 },

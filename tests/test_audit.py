@@ -1,0 +1,62 @@
+"""Visited-set integrity audit (stateright_tpu/audit.py).
+
+The audit is the instrument for the round-3 on-chip paxos count drift
+(BASELINE.md): a duplicate fingerprint in the table means the device insert
+admitted an already-present key. On a healthy backend the audit must come
+back clean for every visited-set structure and both device engines, with
+``entries == unique_state_count()``.
+"""
+
+from stateright_tpu.audit import audit_table
+from stateright_tpu.models.paxos import PackedPaxos
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+
+def _assert_clean(checker, expected_unique):
+    report = audit_table(checker)
+    assert report["ok"], report
+    assert report["duplicate_keys"] == 0, report
+    assert report["entries"] == expected_unique == report["unique_count"], report
+
+
+def test_audit_clean_all_structures_single_chip():
+    for dedup in ("hash", "sorted", "delta"):
+        c = (
+            PackedTwoPhaseSys(3)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 8, table_capacity=1 << 10, dedup=dedup)
+        )
+        c.join()
+        assert c.unique_state_count() == 288, dedup
+        _assert_clean(c, 288)
+
+
+def test_audit_clean_after_growth():
+    # Mid-run table growth is the prime suspect window for lost/duplicated
+    # entries: start the table far too small so every structure grows.
+    for dedup in ("hash", "sorted", "delta"):
+        c = (
+            PackedPaxos(2, 2)
+            .checker()
+            .spawn_xla(frontier_capacity=1 << 8, table_capacity=1 << 7, dedup=dedup)
+        )
+        c.join()
+        _assert_clean(c, c.unique_state_count())
+
+
+def test_audit_clean_sharded_engine():
+    from stateright_tpu.parallel import default_mesh
+
+    c = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(
+            mesh=default_mesh(8),
+            frontier_capacity=1 << 9,
+            table_capacity=1 << 10,
+            dedup="sorted",
+        )
+    )
+    c.join()
+    assert c.unique_state_count() == 288
+    _assert_clean(c, 288)
