@@ -76,6 +76,7 @@ class ShardedXlaChecker(Checker):
         levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
         dedup: str = "auto",
+        host_verified_cap: int = 128,
     ):
         import jax
         import jax.numpy as jnp
@@ -83,12 +84,6 @@ class ShardedXlaChecker(Checker):
 
         model = builder._model
         _require_packed(model)
-        if getattr(model, "host_verified_properties", ()):
-            raise NotImplementedError(
-                "host-verified properties are not yet supported on the "
-                "sharded engine; use single-chip spawn_xla() for models "
-                "with consistency-tester properties."
-            )
         self._model = model
         self._mesh = mesh
         self._D = mesh.devices.size
@@ -121,6 +116,27 @@ class ShardedXlaChecker(Checker):
         self._W = model.state_words
         self._A = model.max_actions
         self._P = len(self._properties)
+        # Host-verified properties on the mesh (the single-chip contract,
+        # xla.py: device flags candidate states with a conservative
+        # predicate, the host confirms with the exact object-level
+        # condition). Each shard compacts up to ``host_verified_cap``
+        # candidate rows per super-step; the buffers stay sharded on device
+        # and are only materialized host-side (``_host_read`` — an
+        # allgather under ``jax.distributed``) when a level actually
+        # flagged something.
+        hv_names = frozenset(getattr(model, "host_verified_properties", ()))
+        unknown = hv_names - {p.name for p in self._properties}
+        if unknown:
+            raise ValueError(f"host_verified_properties not in properties(): {unknown}")
+        self._hv_idx = [
+            i for i, p in enumerate(self._properties) if p.name in hv_names
+        ]
+        for i in self._hv_idx:
+            if self._properties[i].expectation == Expectation.EVENTUALLY:
+                raise ValueError(
+                    "host-verified eventually-properties are not supported"
+                )
+        self._hv_cap = host_verified_cap
 
         # Per-shard visited-set structure + bulk-buffer layout, mirroring
         # the single-chip engine (xla.py): accelerators get the sort-merge
@@ -521,6 +537,9 @@ class ShardedXlaChecker(Checker):
         A, W, D = self._A, self._W, self._D
         P_count = self._P
         max_probes = self._max_probes
+        hv_idx = list(self._hv_idx)
+        n_hv = len(hv_idx)
+        hv_cap = self._hv_cap
         LANES = W + 5  # state words + fp_hi, fp_lo, par_hi, par_lo, ebits
         ds = self._ds
         sorted_mode = self._dedup != "hash"  # planes/gather lowering family
@@ -557,7 +576,32 @@ class ShardedXlaChecker(Checker):
             dw = jax.vmap(dedup_words)(frontier)
             fhi, flo = fphash.fingerprint_words(dw, jnp)
 
-            # 1. property evaluation over the local frontier.
+            # 1. property evaluation over the local frontier. Host-verified
+            #    properties compact up to ``hv_cap`` shard-local candidate
+            #    rows instead of pinning a discovery — the host confirms
+            #    with the exact condition (xla.py ``_checking_blocks``).
+            #    Zero-padded rows carry fp (0, 0), which a real state never
+            #    has, so the host needs no per-shard layout bookkeeping.
+            def hv_compact(viol):
+                k = min(hv_cap, Fl)
+                order = jnp.argsort(~viol, stable=True)[:k]
+                m = viol[order]
+                cw = jnp.where(m[:, None], frontier[order], jnp.uint32(0))
+                cf = jnp.where(
+                    m[:, None],
+                    jnp.stack([fhi[order], flo[order]], axis=1),
+                    jnp.uint32(0),
+                )
+                if k < hv_cap:
+                    cw = jnp.concatenate(
+                        [cw, jnp.zeros((hv_cap - k, W), jnp.uint32)]
+                    )
+                    cf = jnp.concatenate(
+                        [cf, jnp.zeros((hv_cap - k, 2), jnp.uint32)]
+                    )
+                return cw, cf, jnp.sum(viol, dtype=jnp.int32)
+
+            hv_w_out, hv_f_out, hv_c_out = [], [], []
             props = jax.vmap(model.packed_properties)(frontier)  # [Fl, P]
             for i, expectation in prop_specs:
                 if expectation == Expectation.EVENTUALLY:
@@ -569,9 +613,23 @@ class ShardedXlaChecker(Checker):
                     viol = ~props[:, i] & f_valid
                 else:
                     viol = props[:, i] & f_valid
+                if i in hv_idx:
+                    cw, cf, n_viol = hv_compact(viol)
+                    hv_w_out.append(cw)
+                    hv_f_out.append(cf)
+                    hv_c_out.append(n_viol)
+                    continue
                 disc_found, disc_fp = pick_discovery(
                     disc_found, disc_fp, i, viol, fhi, flo
                 )
+            if n_hv:
+                hv_w = jnp.stack(hv_w_out)  # [n_hv, hv_cap, W]
+                hv_f = jnp.stack(hv_f_out)  # [n_hv, hv_cap, 2]
+                hv_c = jnp.stack(hv_c_out)[:, None]  # [n_hv, 1]
+            else:
+                hv_w = jnp.zeros((0, hv_cap, W), jnp.uint32)
+                hv_f = jnp.zeros((0, hv_cap, 2), jnp.uint32)
+                hv_c = jnp.zeros((0, 1), jnp.int32)
 
             # 2. local action-grid expansion. An optional third output is
             #    the per-action codec-overflow mask (see xla.py superstep
@@ -761,6 +819,9 @@ class ShardedXlaChecker(Checker):
                 frontier_ovf,
                 route_ovf,
                 codec_ovf,
+                hv_w,
+                hv_f,
+                hv_c,
             )
 
         return superstep
@@ -795,6 +856,9 @@ class ShardedXlaChecker(Checker):
                 spec_rep,
                 spec_rep,
                 spec_rep,
+                P(None, "shards", None),  # hv candidate words
+                P(None, "shards", None),  # hv candidate fingerprints
+                P(None, "shards"),  # hv per-shard counts
             ),
         )
 
@@ -812,34 +876,81 @@ class ShardedXlaChecker(Checker):
 
         local_step = self._make_local_step(Fl, Cl, K)
         P_count = self._P
+        W = self._W
+        n_hv = len(self._hv_idx)
+        hv_cap = self._hv_cap
+        hv_pos = {i: j for j, i in enumerate(self._hv_idx)}
 
         def fused(frontier, f_ebits, count, table, disc_found, disc_fp,
                   budget, remaining, host_found):
-            def resolved(df):
+            def resolved(df, g_hv_c):
+                """Every property found on device, already confirmed on
+                host, or — host-verified — with candidates collected
+                somewhere on the mesh (global counts, so all shards agree)."""
                 if P_count == 0:
                     return jnp.bool_(False)
-                return jnp.all(df | host_found)
+                per_prop = [
+                    host_found[i]
+                    | (g_hv_c[hv_pos[i]] > 0 if i in hv_pos else df[i])
+                    for i in range(P_count)
+                ]
+                return jnp.all(jnp.stack(per_prop))
+
+            def hv_pending(g_hv_c):
+                """Any *unconfirmed* host-verified property with collected
+                candidates anywhere on the mesh: exit so the host can
+                confirm — the same one-level candidate budget as the
+                single-chip fused block (xla.py)."""
+                if not n_hv:
+                    return jnp.bool_(False)
+                flags = [
+                    (g_hv_c[j] > 0) & ~host_found[i] for i, j in hv_pos.items()
+                ]
+                return jnp.any(jnp.stack(flags))
 
             def cond(carry):
                 (lvl, committed, fr, eb, cnt, tab, df, dfp, ts, tu, ovf,
-                 gcount) = carry
+                 gcount, hv_w, hv_f, hv_c, g_hv_c) = carry
                 return (
                     (lvl < budget)
                     & (gcount > 0)
                     & ~jnp.any(ovf)
-                    & ~resolved(df)
+                    & ~resolved(df, g_hv_c)
+                    & ~hv_pending(g_hv_c)
                     & (ts < remaining)
                 )
 
             def body(carry):
                 (lvl, committed, fr, eb, cnt, tab, df, dfp, ts, tu, ovf,
-                 gcount) = carry
+                 gcount, hv_w, hv_f, hv_c, g_hv_c) = carry
                 (nf, ne, ncnt, ntab, ndf, ndfp, ds, du, t_ovf, f_ovf,
-                 r_ovf, c_ovf) = local_step(fr, eb, cnt, tab, df, dfp)
+                 r_ovf, c_ovf, lw, lf, lc) = local_step(fr, eb, cnt, tab, df, dfp)
                 commit = ~(t_ovf | f_ovf | r_ovf | c_ovf)
                 sel = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(commit, a, b), new, old
                 )
+                # Append this level's shard-local candidates to the block
+                # accumulators (level order across the block, shard-local
+                # frontier order within a level).
+                if n_hv:
+                    rows = jnp.arange(hv_cap)
+                    new_w, new_f = hv_w, hv_f
+                    for j in range(n_hv):
+                        dst = hv_c[j, 0] + rows
+                        ok = (rows < lc[j, 0]) & (dst < hv_cap)
+                        tgt = jnp.where(ok, dst, hv_cap)
+                        new_w = new_w.at[j].set(
+                            new_w[j].at[tgt].set(lw[j], mode="drop")
+                        )
+                        new_f = new_f.at[j].set(
+                            new_f[j].at[tgt].set(lf[j], mode="drop")
+                        )
+                    hv_w = sel(new_w, hv_w)
+                    hv_f = sel(new_f, hv_f)
+                    hv_c = sel(hv_c + lc, hv_c)
+                    g_hv_c = sel(
+                        g_hv_c + jax.lax.psum(lc[:, 0], "shards"), g_hv_c
+                    )
                 return (
                     lvl + 1,
                     committed + commit.astype(jnp.int32),
@@ -853,6 +964,10 @@ class ShardedXlaChecker(Checker):
                     tu + jnp.where(commit, du, 0),
                     jnp.stack([t_ovf, f_ovf, r_ovf, c_ovf]),
                     jnp.where(commit, jax.lax.psum(ncnt[0], "shards"), gcount),
+                    hv_w,
+                    hv_f,
+                    hv_c,
+                    g_hv_c,
                 )
 
             carry0 = (
@@ -868,9 +983,15 @@ class ShardedXlaChecker(Checker):
                 jnp.int32(0),
                 jnp.zeros((4,), jnp.bool_),
                 jax.lax.psum(count[0], "shards"),
+                jnp.zeros((n_hv, hv_cap, W), jnp.uint32),
+                jnp.zeros((n_hv, hv_cap, 2), jnp.uint32),
+                jnp.zeros((n_hv, 1), jnp.int32),
+                jnp.zeros((n_hv,), jnp.int32),
             )
             out = jax.lax.while_loop(cond, body, carry0)
-            return out[1:11]  # drop the level counter and the global count
+            # Drop the level counter, the global count and the replicated
+            # hv count (the host reads the per-shard counts plane).
+            return out[1:11] + out[12:15]
 
         TL = self._table_len()
         spec_rows = P("shards", None)
@@ -900,6 +1021,9 @@ class ShardedXlaChecker(Checker):
                 spec_rep,
                 spec_rep,
                 spec_rep,
+                P(None, "shards", None),  # hv candidate words
+                P(None, "shards", None),  # hv candidate fingerprints
+                P(None, "shards"),  # hv per-shard counts ([n_hv, D])
             ),
         )
 
@@ -973,12 +1097,19 @@ class ShardedXlaChecker(Checker):
         ownership keeps per-shard load within noise of the global figure."""
         from ..xla import XlaChecker
 
-        if self._dedup == "sorted":
-            num, den = XlaChecker.SORTED_LOAD_NUM, XlaChecker.SORTED_LOAD_DEN
-        else:
+        if self._dedup == "hash":
             num, den = XlaChecker.MAX_LOAD_NUM, XlaChecker.MAX_LOAD_DEN
-        while self._unique_count * den > self._D * self._Cl * num:
+        else:
+            # Both sort-based structures take the dense (3/4) rule, and the
+            # capacity term mirrors xla.py's ``self._table.capacity``: for
+            # the delta structure that includes the delta tier.
+            num, den = XlaChecker.SORTED_LOAD_NUM, XlaChecker.SORTED_LOAD_DEN
+        cap_l = self._Cl + (self._delta_cap() if self._dedup == "delta" else 0)
+        while self._unique_count * den > self._D * cap_l * num:
             self._grow_table()
+            cap_l = self._Cl + (
+                self._delta_cap() if self._dedup == "delta" else 0
+            )
 
     def _grow_table(self) -> None:
         """Double every shard's table partition (ownership is capacity-
@@ -1169,6 +1300,50 @@ class ShardedXlaChecker(Checker):
             if found[i] and name not in self._found_names:
                 self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
 
+    def _confirm_hv_candidates(self, hv_w, hv_f, hv_c) -> None:
+        """Exact host-side re-check of device-flagged candidate states for
+        host-verified properties — the single-chip contract
+        (xla.py ``_confirm_hv_candidates``) over the mesh's allgathered
+        candidate buffers. Confirmation order is shard-major (owner shard
+        0's rows first): deterministic, but a different witness tiebreak
+        than the single-chip engine's frontier order — the same documented
+        divergence as ``pick_discovery``'s pmax election. Zero-fingerprint
+        rows are padding (a real state never fingerprints to (0, 0))."""
+        counts = self._host_read(hv_c)  # [n_hv, D]
+        words = fps = None
+        for j, i in enumerate(self._hv_idx):
+            prop = self._properties[i]
+            if prop.name in self._found_names:
+                continue
+            total = int(counts[j].sum())
+            if total == 0:
+                continue
+            if words is None:
+                words = self._host_read(hv_w)  # [n_hv, D*hv_cap, W]
+                fps = self._host_read(hv_f)  # [n_hv, D*hv_cap, 2]
+            confirmed = False
+            collected = 0
+            for r in range(words.shape[1]):
+                fp_hi, fp_lo = int(fps[j, r, 0]), int(fps[j, r, 1])
+                if fp_hi == 0 and fp_lo == 0:
+                    continue
+                collected += 1
+                state = self._model.unpack(words[j, r])
+                holds = bool(prop.condition(self._model, state))
+                viol = (not holds) if prop.expectation == Expectation.ALWAYS else holds
+                if viol:
+                    self._found_names[prop.name] = (fp_hi << 32) | fp_lo
+                    confirmed = True
+                    break
+            if not confirmed and total > collected:
+                raise RuntimeError(
+                    f"{total} candidate states for host-verified property "
+                    f"{prop.name!r} in one super-step, none of the "
+                    f"{collected} collected confirmed — tighten the "
+                    "conservative device predicate or raise "
+                    "spawn_xla(host_verified_cap=...)."
+                )
+
     def _run_block_fused(self) -> None:
         """Up to ``levels_per_dispatch`` BFS levels in one SPMD dispatch
         (see ``_build_fused``); overflow exits commit the non-overflowing
@@ -1205,6 +1380,9 @@ class ShardedXlaChecker(Checker):
                 tot_states,
                 tot_unique,
                 ovf,
+                hv_w,
+                hv_f,
+                hv_c,
             ) = fn(
                 self._frontier,
                 self._frontier_ebits,
@@ -1231,6 +1409,8 @@ class ShardedXlaChecker(Checker):
             self._grow_table_if_loaded()
             grew_proactively = self._Cl > Cl_before
             self._pin_found_names()
+            if self._hv_idx:
+                self._confirm_hv_candidates(hv_w, hv_f, hv_c)
             if (
                 self._target_state_count is not None
                 and self._state_count >= self._target_state_count
@@ -1280,7 +1460,7 @@ class ShardedXlaChecker(Checker):
                 self._disc_fp,
             )
             (nf, ne, ncounts, table, dfound, dfp, d_states, d_unique,
-             t_ovf, f_ovf, r_ovf, c_ovf) = out
+             t_ovf, f_ovf, r_ovf, c_ovf, hv_w, hv_f, hv_c) = out
             if bool(np.asarray(c_ovf)):
                 self._raise_codec_overflow()
             if bool(np.asarray(t_ovf)):
@@ -1303,6 +1483,8 @@ class ShardedXlaChecker(Checker):
         self._depth += 1
         self._grow_table_if_loaded()
         self._pin_found_names()
+        if self._hv_idx:
+            self._confirm_hv_candidates(hv_w, hv_f, hv_c)
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count

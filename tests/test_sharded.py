@@ -269,3 +269,112 @@ def test_sharded_delta_dedup_matches_sorted():
     assert set(da) == set(db) and da
     for name in da:
         assert da[name].into_states() == db[name].into_states()
+
+
+# --- host-verified properties on the mesh (VERDICT r3 #4) -----------------
+
+
+def _forced_hv(model):
+    """Route the model's consistency property through the engine's
+    host-verified path. The packed device predicate for these shapes is
+    EXACT, so using it as the 'conservative' hv predicate is sound — this
+    isolates the mesh's candidate compaction / allgather / host-confirm
+    machinery at test-suite scale."""
+    model.host_verified_properties = frozenset({model._prop_name})
+    return model
+
+
+def test_sharded_hv_counterexample_single_copy_2c2s():
+    from stateright_tpu.models.single_copy_register import (
+        PackedSingleCopyRegister,
+    )
+
+    # The stale-read counterexample config (single-copy-register.rs:136).
+    # Parity target is the single-chip DEVICE engine with hv forced the
+    # same way: both engines stop at the end of the level where the host
+    # confirms the violation.
+    single = (
+        _forced_hv(PackedSingleCopyRegister(2, 2))
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 9, table_capacity=1 << 11)
+        .join()
+    )
+    mesh = (
+        _forced_hv(PackedSingleCopyRegister(2, 2))
+        .checker()
+        .spawn_xla(
+            mesh=_mesh(), frontier_capacity=1 << 9, table_capacity=1 << 11
+        )
+        .join()
+    )
+    assert "linearizable" in mesh.discoveries()
+    assert set(mesh.discoveries()) == set(single.discoveries())
+    assert mesh.unique_state_count() == single.unique_state_count()
+    assert mesh.state_count() == single.state_count()
+    # The witness must be a real path ending in a non-linearizable state.
+    mesh.assert_discovery(
+        "linearizable", mesh.discoveries()["linearizable"].into_actions()
+    )
+
+
+def test_sharded_hv_full_coverage_single_copy_2c1s():
+    from stateright_tpu.models.single_copy_register import (
+        PackedSingleCopyRegister,
+    )
+
+    # One server: 'linearizable' HOLDS, so the hv path must confirm nothing
+    # and the search must reach exact full coverage (the 93-state anchor,
+    # single-copy-register.rs:110).
+    mesh = (
+        _forced_hv(PackedSingleCopyRegister(2, 1))
+        .checker()
+        .spawn_xla(
+            mesh=_mesh(), frontier_capacity=1 << 9, table_capacity=1 << 11
+        )
+        .join()
+    )
+    assert mesh.unique_state_count() == 93
+    assert mesh.state_count() == 121
+    assert "linearizable" not in mesh.discoveries()
+    mesh.assert_properties()
+
+
+def test_sharded_device_exact_lin_models_mesh_parity():
+    from stateright_tpu.models.linearizable_register import PackedAbd
+    from stateright_tpu.models.single_copy_register import (
+        PackedSingleCopyRegister,
+    )
+
+    # ABD 2c/2s reaches full coverage: the 544-state reference anchor
+    # (linearizable-register.rs:289) must hold exactly on the mesh.
+    abd = (
+        PackedAbd(2, 2)
+        .checker()
+        .spawn_xla(
+            mesh=_mesh(), frontier_capacity=1 << 10, table_capacity=1 << 12
+        )
+        .join()
+    )
+    assert abd.unique_state_count() == 544
+    assert abd.state_count() == 875
+    assert set(abd.discoveries()) == {"value chosen"}
+
+    # single-copy 2c/2s stops at the counterexample; parity target is the
+    # single-chip device engine (same level-synchronous early exit).
+    single = (
+        PackedSingleCopyRegister(2, 2)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 9, table_capacity=1 << 11)
+        .join()
+    )
+    mesh = (
+        PackedSingleCopyRegister(2, 2)
+        .checker()
+        .spawn_xla(
+            mesh=_mesh(), frontier_capacity=1 << 9, table_capacity=1 << 11
+        )
+        .join()
+    )
+    assert set(mesh.discoveries()) == set(single.discoveries())
+    assert mesh.unique_state_count() == single.unique_state_count()
+    assert mesh.state_count() == single.state_count()
