@@ -30,7 +30,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_exact_parity():
+def _run_two_process(config: str) -> str:
+    """Launch the 2-process mesh on ``config``; returns the (identical on
+    both ranks) RESULT payload."""
     port = _free_port()
     env = dict(os.environ)
     # The workers pick their own backend/device-count; the conftest's
@@ -38,7 +40,7 @@ def test_two_process_mesh_exact_parity():
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(rank), "2", str(port)],
+            [sys.executable, WORKER, str(rank), "2", str(port), config],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -64,18 +66,66 @@ def test_two_process_mesh_exact_parity():
         )
         results.append(lines[0].split(" ", 2)[2])  # strip "RESULT pid=k"
 
-    # Both processes observe the same global result...
+    # Every process observes the same global result.
     assert results[0] == results[1]
-    # ...and it is the host oracle's exact count profile for 2pc(3)
-    # (BASELINE.md: 288 unique / 1,146 generated incl. init), with both
-    # SOMETIMES witnesses reconstructed at BFS-minimal depth.
+    return results[0]
+
+
+def _oracle_2pc3_result() -> str:
+    """The host oracle's exact count profile for 2pc(3) (BASELINE.md: 288
+    unique / 1,146 generated incl. init), with both SOMETIMES witnesses at
+    BFS-minimal depth."""
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
     oracle = TwoPhaseSys(3).checker().spawn_bfs().join()
     expected_paths = ";".join(
         f"{name}:{len(path)}" for name, path in sorted(oracle.discoveries().items())
     )
-    assert results[0] == (
+    return (
         f"states={oracle.state_count()} unique={oracle.unique_state_count()} "
         f"depth={oracle.max_depth()} paths={expected_paths}"
+    )
+
+
+def test_two_process_mesh_exact_parity():
+    assert _run_two_process("2pc") == _oracle_2pc3_result()
+
+
+def test_two_process_mesh_sorted_structure():
+    # The accelerator-default sort-merge visited set across the process
+    # boundary: same exact profile, different dedup/compaction lowerings.
+    assert _run_two_process("2pc-sorted") == _oracle_2pc3_result()
+
+
+def test_two_process_mesh_delta_structure_with_flushes():
+    # The two-tier delta set at a table size that forces delta flushes and
+    # main-tier growth mid-run, across the process boundary.
+    assert _run_two_process("2pc-delta") == _oracle_2pc3_result()
+
+
+def test_two_process_mesh_eventually_counterexample():
+    # EVENTUALLY semantics (terminal detection + ebits) and witness-path
+    # reconstruction across non-addressable parent-map shards must match
+    # the single-chip device engine bit-for-bit.
+    from stateright_tpu.core import Property
+    from stateright_tpu.test_util import DGraph, PackedDGraph
+
+    graph = (
+        DGraph.with_property(Property.eventually("odd", lambda _, s: s % 2 == 1))
+        .with_path([0, 2, 4])
+        .with_path([4, 6])
+    )
+    single = (
+        PackedDGraph(graph)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 9, table_capacity=1 << 12)
+        .join()
+    )
+    expected_paths = ";".join(
+        f"{name}:{len(path)}" for name, path in sorted(single.discoveries().items())
+    )
+    assert "odd" in single.discoveries()  # the cycle-free terminal cex
+    assert _run_two_process("ev") == (
+        f"states={single.state_count()} unique={single.unique_state_count()} "
+        f"depth={single.max_depth()} paths={expected_paths}"
     )
