@@ -1056,9 +1056,18 @@ class XlaChecker(Checker):
         cap = caps.get(run_cap)
         if cap is None:
             m = run_cap * self._A
-            # Power-of-two (not four): a pow4 ladder can land just above
-            # m/4 at the big buckets and erase most of the compaction win.
-            cap = max(1024, self._next_pow2(max(m // 4, 1)))
+            if run_cap <= 256:
+                # Small buckets take the FULL grid: compaction saves
+                # nothing at this scale, and an undersized buffer costs a
+                # cc_ovf -> grow -> fresh-XLA-compile round per growth —
+                # the dominant warm-pass term for ramping spaces once the
+                # bucket ladder starts at 64.
+                cap = self._next_pow2(m)
+            else:
+                # Power-of-two (not four): a pow4 ladder can land just
+                # above m/4 at the big buckets and erase most of the
+                # compaction win.
+                cap = max(1024, self._next_pow2(max(m // 4, 1)))
             caps[run_cap] = cap = min(cap, self._next_pow2(m))
         return cap
 
@@ -1187,11 +1196,19 @@ class XlaChecker(Checker):
 
     def _run_cap_for(self, n: int) -> int:
         """Smallest power-of-FOUR run capacity with ~4x expansion headroom
-        over the live frontier, clamped to [1024, frontier_capacity].
+        over the live frontier, clamped to [64, frontier_capacity].
         Powers of four keep the compiled-bucket count low (each distinct
-        run capacity is a separate XLA compilation)."""
-        want = max(4 * max(n, 1), 1024)
-        cap = 1024
+        run capacity is a separate XLA compilation).
+
+        The 64-row floor matters for the deep-narrow spaces the
+        consistency testers produce (round-3 on-chip finding: ABD 2c/2s
+        never widens past 54 rows, so a 1024-row floor paid a ~1000x
+        action-grid padding tax per level — measured 66x end-to-end on
+        CPU). Wide spaces ramp through at most two extra small buckets
+        (64, 256), each a far cheaper XLA compile than the big ones and
+        persistent-cache-amortized across runs."""
+        want = max(4 * max(n, 1), 64)
+        cap = 64
         while cap < want:
             cap *= 4
         return min(cap, self._frontier_capacity)
