@@ -54,15 +54,18 @@ class CheckerBuilder:
         (checker.rs:171). ``engine="xla"`` runs it on the device engine
         (packed models; ``spawn_kwargs`` are ``spawn_xla`` capacities) —
         targeted expansions dispatch compiled super-steps and
-        ``run_to_completion()`` hands over to the fused batch engine."""
+        ``run_to_completion()`` hands over to the fused batch engine.
+        The host engine accepts ``block_size`` (default 1): with the
+        reference's 1500 a ``check_fingerprint`` pre-computes up to that
+        many states of the clicked subtree (on_demand.rs:209-218)."""
         if engine == "xla":
             from .device_on_demand import DeviceOnDemandChecker
 
             return DeviceOnDemandChecker(self, **spawn_kwargs)
-        if spawn_kwargs:
+        unknown = set(spawn_kwargs) - {"block_size"}
+        if unknown:
             raise TypeError(
-                f"spawn kwargs {sorted(spawn_kwargs)} only apply to "
-                'engine="xla"'
+                f"spawn kwargs {sorted(unknown)} only apply to engine=\"xla\""
             )
         try:
             from .on_demand import OnDemandChecker
@@ -70,7 +73,7 @@ class CheckerBuilder:
             raise NotImplementedError(
                 "spawn_on_demand() is not available yet in this build"
             ) from e
-        return OnDemandChecker(self)
+        return OnDemandChecker(self, **spawn_kwargs)
 
     def spawn_xla(self, *, mesh=None, **kwargs) -> Checker:
         """TPU/XLA frontier-expansion engine: the whole BFS frontier is

@@ -10,15 +10,20 @@ behaves like the batch BFS checker. The Explorer is built on this so the UI
 only computes the states the user clicks.
 
 Design delta from the reference: the reference fans control messages over an
-mpsc channel to waiting worker threads and reuses ``check_block`` (so one
-click may expand up to 1500 states of the clicked subtree); this engine is
-in-process and expands exactly the requested entry per request — the
-demand-driven contract the Explorer actually relies on. A ``join()`` before
-``run_to_completion()`` would deadlock in the reference (workers wait on the
-channel forever); here it raises instead of hanging.
+mpsc channel to waiting worker threads and reuses ``check_block`` — one
+click expands up to 1500 states of the clicked subtree
+(on_demand.rs:209-218). This engine is in-process; ``block_size`` picks the
+granularity: at the reference's 1500 a click pre-computes the clicked
+subtree block exactly as upstream does, while the Explorer spawns with
+``block_size=1`` (expand exactly the clicked entry — the demand-driven
+contract its UI counts rely on). A ``join()`` before
+``run_to_completion()`` would deadlock in the reference (workers wait on
+the channel forever); here it raises instead of hanging.
 """
 
 from __future__ import annotations
+
+from collections import deque
 
 from .search import SearchChecker
 
@@ -26,22 +31,57 @@ from .search import SearchChecker
 class OnDemandChecker(SearchChecker):
     """Spawned via ``CheckerBuilder.spawn_on_demand()`` (checker.rs:163-171)."""
 
-    def __init__(self, builder):
+    def __init__(self, builder, block_size: int = 1):
         super().__init__(builder, lifo=False)
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
         self._waiting = True
+        self._block_size = block_size
 
     # --- control flow (checker.rs:259-266) --------------------------------
 
     def check_fingerprint(self, fingerprint: int) -> None:
         """Evaluates and expands the pending frontier entry with this
-        fingerprint, if any (on_demand.rs:460-465). Unknown or already
-        processed fingerprints are ignored, as in the reference."""
+        fingerprint, if any (on_demand.rs:460-465), then — with
+        ``block_size > 1`` — BFS-expands the clicked subtree up to
+        ``block_size`` states total, the reference's ``check_block`` reuse
+        (on_demand.rs:209-218). Unknown or already processed fingerprints
+        are ignored, as in the reference."""
         if not self._waiting:
             return
         for i, entry in enumerate(self._pending):
             if entry[1] == fingerprint:
                 del self._pending[i]
-                self._evaluate_and_expand(*entry)
+                # Entries the expansion prepends (BFS mode appendlefts
+                # successors; each is unique — search.py dedups via
+                # _generated before enqueueing) are the clicked subtree's
+                # next frontier. Pop them straight off the deque into a
+                # local BFS queue (reversed: appendleft stores siblings
+                # newest-leftmost) and expand within the block budget;
+                # a False from _evaluate_and_expand stops the block
+                # immediately, like _run_block and the reference's
+                # check_block.
+                subtree = deque()
+
+                def pull_new(before_len):
+                    added = len(self._pending) - before_len
+                    subtree.extend(
+                        reversed([self._pending.popleft() for _ in range(added)])
+                    )
+
+                before = len(self._pending)
+                keep_going = self._evaluate_and_expand(*entry)
+                pull_new(before)
+                expanded = 1
+                while subtree and keep_going and expanded < self._block_size:
+                    e = subtree.popleft()
+                    before = len(self._pending)
+                    keep_going = self._evaluate_and_expand(*e)
+                    pull_new(before)
+                    expanded += 1
+                # Unexpanded subtree entries rejoin the frontier in their
+                # original newest-leftmost layout.
+                self._pending.extendleft(subtree)
                 return
 
     def run_to_completion(self) -> None:

@@ -77,3 +77,45 @@ def test_demand_driven_discovery_completes():
     full = TwoPhaseSys(2).checker().spawn_bfs().join()
     # Driving every pending entry visits the whole space.
     assert checker.unique_state_count() == full.unique_state_count()
+
+
+def test_block_size_expands_clicked_subtree():
+    # The reference's granularity: one click pre-computes up to a
+    # 1500-state block of the clicked subtree (on_demand.rs:209-218).
+    # 2pc(3) has 288 reachable states, so a big-block click on the single
+    # init state computes the ENTIRE space in one request.
+    model = TwoPhaseSys(3)
+    checker = model.checker().spawn_on_demand(block_size=1500)
+    init_fp = fingerprint(model.init_states()[0])
+    checker.check_fingerprint(init_fp)
+    assert checker.unique_state_count() == 288
+    assert checker.is_done()  # the driven frontier ran dry
+
+    # A bounded block stops at the budget.
+    bounded = model.checker().spawn_on_demand(block_size=10)
+    bounded.check_fingerprint(init_fp)
+    assert not bounded.is_done()
+    assert 10 <= bounded.unique_state_count() < 288
+
+
+def test_block_size_one_is_exact_entry():
+    model = BinaryClock()
+    checker = model.checker().spawn_on_demand(block_size=1)
+    checker.check_fingerprint(fingerprint(model.init_states()[0]))
+    assert checker.max_depth() == 1
+    assert checker.unique_state_count() == 2
+
+
+def test_block_expansion_respects_target_state_count():
+    # The block must stop as soon as the engine signals stop (the
+    # reference's check_block bails mid-block too) — a state-count target
+    # set below the block budget caps the click's expansion.
+    model = TwoPhaseSys(3)
+    checker = model.checker().target_state_count(50).spawn_on_demand(
+        block_size=1500
+    )
+    checker.check_fingerprint(fingerprint(model.init_states()[0]))
+    assert checker.is_done()
+    # One overshooting expansion at most (the signal lands after the
+    # expansion that crosses the target, as in _run_block).
+    assert checker.state_count() < 50 + 16
