@@ -1,0 +1,62 @@
+"""Guarded accelerator-backend selection for the CLIs.
+
+The deployment reality this package targets (SURVEY §2.8; CLAUDE.md): the
+TPU can sit behind a tunnel that WEDGES — ``jax.devices()`` blocks forever
+instead of failing — and the container's sitecustomize registers the
+accelerator plugin at *config* level, so merely importing jax in a CLI
+would hang the process when the tunnel is down. ``bench.py`` probes in a
+watchdog subprocess for exactly this reason; this module gives the example
+CLIs the same protection without duplicating it seven times.
+
+Library code does NOT call this: engines run on whatever backend the
+embedding application configured. Only the ``main()`` entry points (a
+human at a shell, expecting an answer, not a hang) pay the probe.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def ensure_live_backend(timeout_s: int = 45) -> str:
+    """Probe the default jax backend in a watchdog subprocess; pin this
+    process to CPU if the accelerator is unreachable or wedges.
+
+    Returns the platform name the process will use ("tpu", "cpu", ...).
+    Must be called BEFORE the first jax backend use in this process.
+
+    The probe subprocess pays the full plugin initialization; a healthy
+    accelerator answers in a few seconds, a wedged tunnel burns the
+    timeout once, and either way the CLI never hangs.
+    """
+    probe = (
+        "import jax; ds = jax.devices(); print('PLATFORM', ds[0].platform)"
+    )
+    platform = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("PLATFORM "):
+                platform = line.split(" ", 1)[1].strip()
+                break
+        else:
+            proc = None
+    except (subprocess.TimeoutExpired, OSError):
+        proc = None
+    if proc is None or platform == "cpu":
+        print(
+            "accelerator unreachable (or CPU-only build); running on CPU",
+            file=sys.stderr,
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    return platform

@@ -189,23 +189,28 @@ class PackedIncrementLock(IncrementLock):
 
 
 def main(argv=None) -> None:
-    """CLI mirroring increment_lock.rs:109-161, plus ``check-xla``."""
+    """CLI mirroring increment_lock.rs:109-161. ``check`` runs the device
+    (XLA) engine — the reference's ``check`` likewise runs its fastest
+    checker; ``check-host`` is the sequential Python oracle."""
     import sys
 
     from ..report import WriteReporter
 
     args = list(sys.argv[1:] if argv is None else argv)
     cmd = args.pop(0) if args else None
-    if cmd == "check":
-        thread_count = int(args.pop(0)) if args else 3
-        print(f"Model checking increment_lock with {thread_count} threads.")
-        IncrementLock(thread_count).checker().spawn_dfs().report(WriteReporter())
-    elif cmd == "check-xla":
+    if cmd in ("check", "check-xla"):
+        from ..backend import ensure_live_backend
+
+        ensure_live_backend()
         thread_count = int(args.pop(0)) if args else 3
         print(f"Model checking increment_lock with {thread_count} threads on XLA.")
         PackedIncrementLock(thread_count).checker().spawn_xla(
             frontier_capacity=1 << 12, table_capacity=1 << 16
         ).report(WriteReporter())
+    elif cmd == "check-host":
+        thread_count = int(args.pop(0)) if args else 3
+        print(f"Model checking increment_lock with {thread_count} threads.")
+        IncrementLock(thread_count).checker().spawn_dfs().report(WriteReporter())
     elif cmd == "check-sym":
         thread_count = int(args.pop(0)) if args else 3
         print(
@@ -225,9 +230,10 @@ def main(argv=None) -> None:
         IncrementLock(thread_count).checker().serve(address)
     else:
         print("USAGE:")
-        print("  increment_lock check [THREAD_COUNT]")
+        print("  increment_lock check [THREAD_COUNT]        (device/XLA engine)")
+        print("  increment_lock check-host [THREAD_COUNT]   (sequential host oracle)")
         print("  increment_lock check-sym [THREAD_COUNT]")
-        print("  increment_lock check-xla [THREAD_COUNT]")
+        print("  increment_lock check-xla [THREAD_COUNT]    (alias of check)")
         print("  increment_lock explore [THREAD_COUNT] [ADDRESS]")
 
 

@@ -766,7 +766,38 @@ def main(argv=None) -> None:
 
     args = list(sys.argv[1:] if argv is None else argv)
     cmd = args.pop(0) if args else None
-    if cmd == "check":
+    if cmd in ("check", "check-xla"):
+        # ``check`` runs the device (XLA) engine — the reference's check
+        # likewise runs its fastest checker. A custom NETWORK falls back to
+        # the host oracle (the packed codec models the default network).
+        client_count = int(args.pop(0)) if args else 2
+        network = Network.from_name(args.pop(0)) if args else None
+        if network is None:
+            from ..backend import ensure_live_backend
+
+            ensure_live_backend()
+            print(
+                f"Model checking Single Decree Paxos with {client_count} "
+                "clients on XLA."
+            )
+            (
+                PackedPaxos(client_count, 3)
+                .checker()
+                .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 16)
+                .report(WriteReporter())
+            )
+        else:
+            print(
+                f"Model checking Single Decree Paxos with {client_count} "
+                "clients."
+            )
+            (
+                paxos_model(client_count, 3, network)
+                .checker()
+                .spawn_dfs()
+                .report(WriteReporter())
+            )
+    elif cmd == "check-host":
         client_count = int(args.pop(0)) if args else 2
         network = Network.from_name(args.pop(0)) if args else None
         print(f"Model checking Single Decree Paxos with {client_count} clients.")
@@ -774,18 +805,6 @@ def main(argv=None) -> None:
             paxos_model(client_count, 3, network)
             .checker()
             .spawn_dfs()
-            .report(WriteReporter())
-        )
-    elif cmd == "check-xla":
-        client_count = int(args.pop(0)) if args else 2
-        print(
-            f"Model checking Single Decree Paxos with {client_count} clients "
-            "on XLA."
-        )
-        (
-            PackedPaxos(client_count, 3)
-            .checker()
-            .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 16)
             .report(WriteReporter())
         )
     elif cmd == "explore":
@@ -821,8 +840,9 @@ def main(argv=None) -> None:
         )
     else:
         print("USAGE:")
-        print("  paxos check [CLIENT_COUNT] [NETWORK]")
-        print("  paxos check-xla [CLIENT_COUNT]")
+        print("  paxos check [CLIENT_COUNT] [NETWORK]  (device/XLA engine)")
+        print("  paxos check-host [CLIENT_COUNT] [NETWORK]  (sequential host oracle)")
+        print("  paxos check-xla [CLIENT_COUNT]  (alias of check)")
         print("  paxos explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  paxos spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")

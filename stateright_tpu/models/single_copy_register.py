@@ -615,7 +615,43 @@ def main(argv=None) -> None:
 
     args = list(sys.argv[1:] if argv is None else argv)
     cmd = args.pop(0) if args else None
-    if cmd == "check":
+    if cmd in ("check", "check-xla"):
+        # ``check`` runs the device (XLA) engine — the reference's check
+        # likewise runs its fastest checker. Network semantics the packed
+        # codec does not cover fall back to the host oracle.
+        client_count = int(args.pop(0)) if args and args[0].isdigit() else 2
+        netname = args.pop(0) if args else None
+        if netname in (None, "ordered"):
+            from ..backend import ensure_live_backend
+
+            ensure_live_backend()
+            print(
+                f"Model checking a single-copy register with {client_count} "
+                "clients on XLA."
+            )
+            model = (
+                PackedSingleCopyRegisterOrdered(client_count, 1)
+                if netname == "ordered"
+                else PackedSingleCopyRegister(client_count, 1)
+            )
+            (
+                model.checker()
+                .spawn_xla(frontier_capacity=1 << 11, table_capacity=1 << 14)
+                .report(WriteReporter())
+            )
+        else:
+            network = Network.from_name(netname)
+            print(
+                f"Model checking a single-copy register with {client_count} "
+                "clients."
+            )
+            (
+                single_copy_register_model(client_count, 1, network)
+                .checker()
+                .spawn_dfs()
+                .report(WriteReporter())
+            )
+    elif cmd == "check-host":
         client_count = int(args.pop(0)) if args else 2
         network = Network.from_name(args.pop(0)) if args else None
         print(f"Model checking a single-copy register with {client_count} clients.")
@@ -623,20 +659,6 @@ def main(argv=None) -> None:
             single_copy_register_model(client_count, 1, network)
             .checker()
             .spawn_dfs()
-            .report(WriteReporter())
-        )
-    elif cmd == "check-xla":
-        network = Network.from_name(args.pop(0)) if args else None
-        ordered = network is not None and "Ordered" in type(network).__name__
-        print("Model checking a single-copy register with 2 clients on XLA.")
-        model = (
-            PackedSingleCopyRegisterOrdered(2, 1)
-            if ordered
-            else PackedSingleCopyRegister(2, 1)
-        )
-        (
-            model.checker()
-            .spawn_xla(frontier_capacity=1 << 10, table_capacity=1 << 12)
             .report(WriteReporter())
         )
     elif cmd == "explore":
@@ -665,8 +687,9 @@ def main(argv=None) -> None:
         )
     else:
         print("USAGE:")
-        print("  single-copy-register check [CLIENT_COUNT] [NETWORK]")
-        print("  single-copy-register check-xla [NETWORK]")
+        print("  single-copy-register check [CLIENT_COUNT] [NETWORK]  (device/XLA engine)")
+        print("  single-copy-register check-host [CLIENT_COUNT] [NETWORK]  (sequential host oracle)")
+        print("  single-copy-register check-xla [NETWORK]  (alias of check)")
         print("  single-copy-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  single-copy-register spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")

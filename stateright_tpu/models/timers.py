@@ -265,7 +265,34 @@ def main(argv=None) -> None:
 
     args = list(sys.argv[1:] if argv is None else argv)
     cmd = args.pop(0) if args else None
-    if cmd == "check":
+    if cmd in ("check", "check-xla"):
+        # ``check`` runs the device (XLA) engine; custom network semantics
+        # fall back to the host oracle (the packed codec models the
+        # default network).
+        netname = args.pop(0) if args else None
+        if netname is None:
+            from ..backend import ensure_live_backend
+
+            ensure_live_backend()
+            print("Model checking Pingers on XLA (bounded to 100k states).")
+            (
+                PackedTimers(3)
+                .checker()
+                .target_state_count(100_000)
+                .spawn_xla(frontier_capacity=1 << 15, table_capacity=1 << 18)
+                .report(WriteReporter())
+            )
+        else:
+            network = Network.from_name(netname)
+            print("Model checking Pingers (bounded to 100k states).")
+            (
+                timers_model(3, network)
+                .checker()
+                .target_state_count(100_000)
+                .spawn_dfs()
+                .report(WriteReporter())
+            )
+    elif cmd == "check-host":
         network = Network.from_name(args.pop(0)) if args else None
         print("Model checking Pingers (bounded to 100k states).")
         (
@@ -275,15 +302,6 @@ def main(argv=None) -> None:
             .spawn_dfs()
             .report(WriteReporter())
         )
-    elif cmd == "check-xla":
-        print("Model checking Pingers on XLA (bounded to 100k states).")
-        (
-            PackedTimers(3)
-            .checker()
-            .target_state_count(100_000)
-            .spawn_xla(frontier_capacity=1 << 15, table_capacity=1 << 18)
-            .report(WriteReporter())
-        )
     elif cmd == "explore":
         address = args.pop(0) if args else "localhost:3000"
         network = Network.from_name(args.pop(0)) if args else None
@@ -291,8 +309,9 @@ def main(argv=None) -> None:
         timers_model(3, network).checker().serve(address)
     else:
         print("USAGE:")
-        print("  timers check [NETWORK]")
-        print("  timers check-xla")
+        print("  timers check [NETWORK]       (device/XLA engine)")
+        print("  timers check-host [NETWORK]  (sequential host oracle)")
+        print("  timers check-xla             (alias of check)")
         print("  timers explore [ADDRESS] [NETWORK]")
         print(f"NETWORK: {' | '.join(Network.names())}")
 

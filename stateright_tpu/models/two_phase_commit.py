@@ -327,15 +327,28 @@ class PackedTwoPhaseSys(TwoPhaseSys):
 
 def main(argv=None) -> None:
     """CLI mirroring 2pc.rs:174-255: ``check``/``check-sym``/``check-xla``/
-    ``explore`` subcommands (``check-xla`` is this framework's addition: the
-    same model on the TPU frontier-expansion engine)."""
+    ``explore`` subcommands. ``check`` runs the device (XLA) engine — the
+    reference's ``check`` likewise runs its fastest checker (the 16-thread
+    DFS, 2pc.rs:186-189), so the default here is the engine this framework
+    is built around; ``check-host`` is the sequential Python oracle for
+    semantics-exact comparison runs."""
     import sys
 
     from ..report import WriteReporter
 
     args = list(sys.argv[1:] if argv is None else argv)
     cmd = args.pop(0) if args else None
-    if cmd == "check":
+    if cmd in ("check", "check-xla"):
+        from ..backend import ensure_live_backend
+
+        ensure_live_backend()
+        rm_count = int(args.pop(0)) if args else 2
+        print(
+            f"Checking two phase commit with {rm_count} resource managers "
+            f"on the XLA engine."
+        )
+        PackedTwoPhaseSys(rm_count).checker().spawn_xla().report(WriteReporter())
+    elif cmd == "check-host":
         rm_count = int(args.pop(0)) if args else 2
         print(f"Checking two phase commit with {rm_count} resource managers.")
         TwoPhaseSys(rm_count).checker().spawn_dfs().report(WriteReporter())
@@ -348,13 +361,6 @@ def main(argv=None) -> None:
         TwoPhaseSys(rm_count).checker().symmetry().spawn_dfs().report(
             WriteReporter()
         )
-    elif cmd == "check-xla":
-        rm_count = int(args.pop(0)) if args else 2
-        print(
-            f"Checking two phase commit with {rm_count} resource managers "
-            f"on the XLA engine."
-        )
-        PackedTwoPhaseSys(rm_count).checker().spawn_xla().report(WriteReporter())
     elif cmd == "explore":
         rm_count = int(args.pop(0)) if args else 2
         address = args.pop(0) if args else "localhost:3000"
@@ -365,9 +371,10 @@ def main(argv=None) -> None:
         TwoPhaseSys(rm_count).checker().serve(address)
     else:
         print("USAGE:")
-        print("  two-phase-commit check [RM_COUNT]")
+        print("  two-phase-commit check [RM_COUNT]        (device/XLA engine)")
+        print("  two-phase-commit check-host [RM_COUNT]   (sequential host oracle)")
         print("  two-phase-commit check-sym [RM_COUNT]")
-        print("  two-phase-commit check-xla [RM_COUNT]")
+        print("  two-phase-commit check-xla [RM_COUNT]    (alias of check)")
         print("  two-phase-commit explore [RM_COUNT] [ADDRESS]")
 
 
