@@ -279,10 +279,11 @@ class PackedClientsMixin:
 
         Delegates to the generalized static-enumeration serializer
         (:func:`stateright_tpu.semantics.device.device_serializable`):
-        works for any thread count / op bound whose interleaving count
-        stays under ``semantics.device.MAX_PATTERNS``; larger shapes pass
-        ``pattern_limit`` (a one-sided sampled pass) and declare the
-        property in ``host_verified_properties``.
+        exact for any thread count / op bound whose interleaving count
+        stays under ``semantics.device.MAX_PATTERNS_EXACT`` (the pattern
+        axis chunks under ``lax.scan`` past the single-shot budget);
+        larger shapes pass ``pattern_limit`` (a one-sided sampled pass)
+        and declare the property in ``host_verified_properties``.
 
         Returns a bool usable directly as an ``always`` property —
         differentially tested against ``serialized_history()`` over every

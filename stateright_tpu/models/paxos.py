@@ -243,12 +243,12 @@ class PackedPaxos(reg.PackedClientsMixin, PackedModelAdapter):
         from ..actor.network import Envelope
         from ..packing import BoundedHistory, LayoutBuilder, OverflowError32
 
-        from ..semantics.device import MAX_PATTERNS, pattern_count
+        from ..semantics.device import MAX_PATTERNS_EXACT, pattern_count
 
-        if pattern_count(client_count, 2) > MAX_PATTERNS:
+        if pattern_count(client_count, 2) > MAX_PATTERNS_EXACT:
             raise ValueError(
                 f"{client_count} clients exceed the exact device "
-                "linearizability budget (semantics.device.MAX_PATTERNS); "
+                "linearizability budget (semantics.device.MAX_PATTERNS_EXACT); "
                 "larger sizes run on the host engines"
             )
         C, S = client_count, server_count

@@ -106,10 +106,11 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
     via the static interleaving enumeration
     (:mod:`stateright_tpu.semantics.device`, SURVEY §7 M4 variant (b)) —
     EXACTLY while the client count keeps the enumeration under
-    ``MAX_PATTERNS`` (<= 3 clients at 2 ops each); beyond that the model
-    declares ``host_verified_properties`` and the device runs a diverse
-    sampled one-sided pass with exact host confirmation of flagged rows
-    (variant (a)). With one server the model reaches full coverage (93
+    ``MAX_PATTERNS_EXACT`` (<= 4 clients at 2 ops each; the pattern axis
+    chunks under ``lax.scan`` past the single-shot budget); beyond that
+    the model declares ``host_verified_properties`` and the device runs a
+    diverse sampled one-sided pass with exact host confirmation of flagged
+    rows (variant (a)). With one server the model reaches full coverage (93
     unique states at 2 clients, single-copy-register.rs:110); with two
     servers the stale-read counterexample is found on device
     (single-copy-register.rs:136).
@@ -124,10 +125,11 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         client_count: int = 2,
         server_count: int = 1,
         consistency: str = "linearizable",
+        device_exact: Optional[bool] = None,
     ):
         from ..actor.network import Envelope
         from ..packing import BoundedHistory, LayoutBuilder, OverflowError32
-        from ..semantics.device import MAX_PATTERNS, pattern_count
+        from ..semantics.device import MAX_PATTERNS_EXACT, pattern_count
         from ..semantics.register import Read, ReadOk, Write, WriteOk
 
         self._inner = single_copy_register_model(
@@ -136,11 +138,21 @@ class PackedSingleCopyRegister(reg.PackedClientsMixin, PackedModelAdapter):
         self._consistency = consistency
         self._prop_name = self._inner.properties()[0].name
         # Device-exact serialization checking scales to the interleaving
-        # budget; past it the property runs as a conservative device pass
-        # (a diverse pattern subsample — True proves serializability) with
-        # exact host confirmation of the flagged remainder: the engine's
-        # host_verified_properties path (xla.py M4 variant (a)).
-        if pattern_count(client_count, self.MAX_OPS) > MAX_PATTERNS:
+        # budget (chunked lax.scan past the single-shot lane limit); past
+        # it — or with ``device_exact=False`` — the property runs as a
+        # conservative device pass (a diverse pattern subsample — True
+        # proves serializability) with exact host confirmation of the
+        # flagged remainder: the engine's host_verified_properties path
+        # (xla.py M4 variant (a)).
+        P = pattern_count(client_count, self.MAX_OPS)
+        if device_exact is None:
+            device_exact = P <= MAX_PATTERNS_EXACT
+        elif device_exact and P > MAX_PATTERNS_EXACT:
+            raise ValueError(
+                f"{P} interleavings exceed the exact device budget "
+                "(semantics.device.MAX_PATTERNS_EXACT)"
+            )
+        if not device_exact:
             self.host_verified_properties = frozenset({self._prop_name})
             self._pattern_limit = 20_000
         else:

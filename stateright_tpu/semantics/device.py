@@ -36,10 +36,20 @@ Semantics replicated (differentially tested against the host serializer):
   serializable, matching the testers' HistoryError freeze.
 
 Sizing: P = (T·(M+1))! / ((M+1)!)^T — 20 at 2×2, 1 680 at 3×2, 34 650 at
-3×3. Past ``MAX_PATTERNS`` the enumeration no longer earns its keep on
-device; models should fall back to the engine's
-``host_verified_properties`` path (a conservative device predicate +
-exact host confirmation, xla.py M4 variant (a)).
+3×3, 369 600 at 4×2. Up to ``MAX_PATTERNS`` the whole enumeration runs as
+one ``[P]``-lane pipeline; past it (SURVEY §7 M4 variant (b) widened,
+round 4) the pattern axis is CHUNKED under ``lax.scan`` — live memory is
+bounded by one ``[chunk]`` block while exactness is preserved — up to
+``MAX_PATTERNS_EXACT``. Only beyond that (5 threads × 2 ops = 1.68e8)
+should models fall back to the engine's ``host_verified_properties`` path
+(a conservative sampled device predicate + exact host confirmation,
+xla.py M4 variant (a)).
+
+The pipeline carries per-thread RUNNING counts instead of precomputed
+``slot``/``cnt_before`` tables: the only embedded constant is the
+``tid[P, L]`` thread schedule (int8), which keeps the 4-thread exact
+enumeration's constant footprint at ~4 MB instead of ~90 MB of derived
+tables baked into the executable.
 """
 
 from __future__ import annotations
@@ -50,22 +60,26 @@ from typing import Tuple
 
 import numpy as np
 
-#: Past this many interleavings, refuse and point at host_verified_properties
-#: (4 threads x 2 ops = 369 600 patterns ~ 20x the 3x3 cost per state).
+#: Single-shot lane budget: up to this many interleavings run as one
+#: [P]-lane pipeline with no scan overhead.
 MAX_PATTERNS = 50_000
+#: Exact-enumeration ceiling for the chunked (lax.scan) path. Time-bounded,
+#: not memory-bound: each scan step evaluates one PATTERN_CHUNK block.
+MAX_PATTERNS_EXACT = 2_000_000
+#: Pattern-block width for the scanned path: live intermediates are
+#: [batch, PATTERN_CHUNK] lanes.
+PATTERN_CHUNK = 8_192
 
 
 @lru_cache(maxsize=None)
-def interleaving_tables(
-    T: int, slots: int, limit: int = None
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Static pattern tables for merges of T sequences of ``slots`` slots.
-
-    Returns ``(tid[P, L], slot[P, L], cnt_before[P, L, T])`` where L =
-    T*slots: the thread scheduled at each step, its per-thread slot index,
-    and how many slots of every thread precede the step. With ``limit``
-    (< the full count), a deterministic uniform random sample of ``limit``
-    arrangements is generated directly — the full table is never built.
+def interleaving_tids(T: int, slots: int, limit: int = None) -> np.ndarray:
+    """The ``tid[P, L]`` thread-schedule table for merges of T sequences of
+    ``slots`` slots (L = T*slots): the thread scheduled at each step. The
+    per-thread slot index and preceding-count tables are derivable by a
+    running count and are NOT materialized (see module docstring). With
+    ``limit`` (< the full count), a deterministic uniform random sample of
+    ``limit`` arrangements is generated directly — the full table is never
+    built.
     """
     L = T * slots
     P_full = pattern_count(T, slots - 1)
@@ -99,7 +113,19 @@ def interleaving_tables(
 
         rec(tuple(range(L)), 0, [0] * L)
         tid = np.asarray(pats, dtype=np.int32)
-    P = tid.shape[0]
+    return np.ascontiguousarray(tid.astype(np.int8))
+
+
+@lru_cache(maxsize=None)
+def interleaving_tables(
+    T: int, slots: int, limit: int = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Back-compat view of :func:`interleaving_tids` with the derived
+    tables materialized: ``(tid[P, L], slot[P, L], cnt_before[P, L, T])``.
+    The serializer itself no longer consumes the derived tables (it
+    carries running counts); this form remains for tests/tooling."""
+    tid = interleaving_tids(T, slots, limit).astype(np.int32)
+    P, L = tid.shape
     slot = np.zeros((P, L), dtype=np.int32)
     cnt_before = np.zeros((P, L, T), dtype=np.int32)
     running = np.zeros((P, T), dtype=np.int32)
@@ -185,32 +211,38 @@ def device_serializable(hist, words, spec, *, real_time: bool, pattern_limit=Non
     clear the bulk of the frontier and let the host serializer confirm the
     flagged remainder.
     """
+    import jax
     import jax.numpy as jnp
 
     T = len(hist.thread_ids)
     M = hist.max_ops
     slots = M + 1
-    P = pattern_count(T, M)
-    if P > MAX_PATTERNS and (pattern_limit is None or pattern_limit > MAX_PATTERNS):
+    P_full = pattern_count(T, M)
+    limit = (
+        None
+        if pattern_limit is None or pattern_limit >= P_full
+        else pattern_limit
+    )
+    if (P_full if limit is None else limit) > MAX_PATTERNS_EXACT:
         raise NotImplementedError(
-            f"{P} interleavings ({T} threads x {M}+1 ops) exceeds "
-            f"MAX_PATTERNS={MAX_PATTERNS}; declare the property in "
-            "host_verified_properties instead (conservative device "
-            "predicate — this function with pattern_limit <= MAX_PATTERNS — "
-            "plus exact host confirmation)."
+            f"{P_full if limit is None else limit} interleavings "
+            f"({T} threads x {M}+1 ops"
+            f"{'' if limit is None else f', pattern_limit={limit}'}) exceeds "
+            f"MAX_PATTERNS_EXACT={MAX_PATTERNS_EXACT}; declare the property "
+            "in host_verified_properties instead (conservative device "
+            "predicate — this function with a pattern_limit <= "
+            f"{MAX_PATTERNS_EXACT} — plus exact host confirmation)."
         )
     L_ = hist.layout
     u32 = jnp.uint32
-    tid, slot, cnt_before = interleaving_tables(
-        T, slots, None if pattern_limit is None or pattern_limit >= P else pattern_limit
-    )
-    P = tid.shape[0]
+    tid_np = interleaving_tids(T, slots, limit)  # [P, L] int8
+    P = tid_np.shape[0]
     Lsteps = T * slots
 
     N = jnp.stack([L_.get(words, f"h{t}_n") for t in range(T)])  # [T]
     FL = jnp.stack([L_.get(words, f"h{t}_fl") for t in range(T)])  # [T]
-    # Completed-op tables, padded to `slots` so the static slot index is
-    # always in bounds (the pad row is only gathered when inactive).
+    # Completed-op tables, padded to `slots` so the slot index is always in
+    # bounds (the pad row is only gathered when inactive).
     zero = jnp.uint32(0)
     OP = jnp.stack(
         [
@@ -234,32 +266,63 @@ def device_serializable(hist, words, spec, *, real_time: bool, pattern_limit=Non
             for j in range(M):
                 PRE = PRE.at[t, j, q].set(L_.get(words, f"h{t}_pre", j * npeer + pi))
 
-    v = spec.init_value(jnp, (P,))
-    ok = jnp.ones((P,), bool)
-    for l in range(Lsteps):
-        tl, sl = tid[:, l], slot[:, l]  # static index vectors [P]
-        n_t = N[tl]
-        is_comp = u32(sl) < n_t
-        is_fl = (u32(sl) == n_t) & (FL[tl] != 0)
-        active = is_comp | is_fl
-        o = jnp.where(is_comp, OP[tl, sl], jnp.where(is_fl, FL[tl], zero))
-        r = jnp.where(is_comp, RET[tl, sl], zero)
-        if real_time:
-            rt = jnp.ones((P,), bool)
-            for q in range(T):
-                b = jnp.where(
-                    is_comp, PRE[tl, sl, q], jnp.where(is_fl, FLPRE[tl, q], zero)
-                )
-                # Peer q's completed ops scheduled so far: its slots seen so
-                # far (static), capped at its completed count (dynamic).
-                sched = jnp.minimum(u32(cnt_before[:, l, q]), N[q])
-                # b stores prereq index + 2; 0 = no entry. b >= 2 whenever
-                # nonzero, so b - 2 cannot wrap on the checked branch.
-                rt = rt & ((b == zero) | (b - u32(2) < sched))
-        else:
-            rt = True
-        sem_ok, nv = spec.step(jnp, v, o, r, is_comp)
-        # Inactive (padding) steps constrain nothing and change nothing.
-        ok = ok & (~active | (rt & sem_ok))
-        v = jnp.where(active, nv, v)
-    return (L_.get(words, "h_valid") != 0) & jnp.any(ok)
+    thread_lanes = jnp.arange(T, dtype=jnp.int32)
+
+    def eval_block(tid_blk):
+        """Serializability of this state's history over one [p, L] block of
+        patterns; carries per-thread running counts (see module docstring)."""
+        p = tid_blk.shape[0]
+        running = jnp.zeros((p, T), u32)
+        v = spec.init_value(jnp, (p,))
+        ok = jnp.ones((p,), bool)
+        for l in range(Lsteps):
+            tl = tid_blk[:, l].astype(jnp.int32)  # [p]
+            onehot = tl[:, None] == thread_lanes[None, :]  # [p, T]
+            # This step's per-thread slot index: how many of tl's slots ran.
+            sl = jnp.sum(jnp.where(onehot, running, zero), axis=1)  # [p] u32
+            sl_i = sl.astype(jnp.int32)  # < slots by construction
+            n_t = N[tl]
+            is_comp = sl < n_t
+            is_fl = (sl == n_t) & (FL[tl] != 0)
+            active = is_comp | is_fl
+            o = jnp.where(is_comp, OP[tl, sl_i], jnp.where(is_fl, FL[tl], zero))
+            r = jnp.where(is_comp, RET[tl, sl_i], zero)
+            if real_time:
+                rt = jnp.ones((p,), bool)
+                for q in range(T):
+                    b = jnp.where(
+                        is_comp, PRE[tl, sl_i, q], jnp.where(is_fl, FLPRE[tl, q], zero)
+                    )
+                    # Peer q's completed ops scheduled so far: its running
+                    # count, capped at its completed count (dynamic).
+                    sched = jnp.minimum(running[:, q], N[q])
+                    # b stores prereq index + 2; 0 = no entry. b >= 2
+                    # whenever nonzero, so b - 2 cannot wrap on the checked
+                    # branch.
+                    rt = rt & ((b == zero) | (b - u32(2) < sched))
+            else:
+                rt = True
+            sem_ok, nv = spec.step(jnp, v, o, r, is_comp)
+            # Inactive (padding) steps constrain nothing and change nothing.
+            ok = ok & (~active | (rt & sem_ok))
+            v = jnp.where(active, nv, v)
+            running = running + onehot.astype(u32)
+        return ok
+
+    if P <= MAX_PATTERNS:
+        any_ok = jnp.any(eval_block(jnp.asarray(tid_np)))
+    else:
+        # Chunk the pattern axis under lax.scan: exactness at bounded
+        # memory. The pad block repeats pattern 0 — duplicates cannot
+        # change an any() reduction.
+        C = -(-P // PATTERN_CHUNK)
+        pad = C * PATTERN_CHUNK - P
+        if pad:
+            tid_np = np.concatenate([tid_np, np.tile(tid_np[:1], (pad, 1))])
+        xs = jnp.asarray(tid_np.reshape(C, PATTERN_CHUNK, Lsteps))
+
+        def body(acc, tid_blk):
+            return acc | jnp.any(eval_block(tid_blk)), None
+
+        any_ok, _ = jax.lax.scan(body, jnp.bool_(False), xs)
+    return (L_.get(words, "h_valid") != 0) & any_ok

@@ -80,11 +80,13 @@ def test_three_client_device_exact_full_coverage():
 
 @pytest.mark.slow
 def test_four_client_host_verified_bounded_parity():
-    # 4 threads = 369,600 interleavings: past MAX_PATTERNS the model
-    # declares host_verified_properties and the device runs the sampled
-    # one-sided predicate; flagged rows are confirmed by the host
-    # serializer. Bounded-depth counts must still match the oracle exactly.
-    m = PackedSingleCopyRegister(4, 1)
+    # 4 threads = 369,600 interleavings. Since round 4 the default is
+    # device-EXACT (chunked scan); device_exact=False pins the engine's
+    # host_verified_properties machinery — the sampled one-sided device
+    # predicate with host-serializer confirmation of flagged rows (the
+    # production path for 5+ clients). Bounded-depth counts must still
+    # match the oracle exactly.
+    m = PackedSingleCopyRegister(4, 1, device_exact=False)
     assert m.host_verified_properties == frozenset({"linearizable"})
     c = (
         m.checker()
@@ -115,7 +117,7 @@ def test_four_client_host_verified_finds_real_counterexample():
     # 4c/2s reaches genuinely non-linearizable states: the hv path must
     # confirm one through the host serializer at the oracle's witness depth.
     c = (
-        PackedSingleCopyRegister(4, 2)
+        PackedSingleCopyRegister(4, 2, device_exact=False)
         .checker()
         .spawn_xla(
             frontier_capacity=1 << 12,
@@ -128,3 +130,30 @@ def test_four_client_host_verified_finds_real_counterexample():
     pc = c.discoveries()["linearizable"]
     assert len(pc) == len(h.discoveries()["linearizable"])
     assert pc.last_state().history.serialized_history() is None
+
+
+@pytest.mark.slow
+def test_four_client_device_exact_bounded_parity():
+    # The round-4 widened regime: 4 clients checked device-EXACT (369,600
+    # interleavings, chunked under lax.scan) with no host fallback —
+    # bounded-depth counts match the oracle and nothing is flagged.
+    m = PackedSingleCopyRegister(4, 1)
+    assert not getattr(m, "host_verified_properties", None)
+    c = (
+        m.checker()
+        .target_max_depth(6)
+        .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 15)
+        .join()
+    )
+    h = (
+        single_copy_register_model(4, 1)
+        .checker()
+        .target_max_depth(6)
+        .spawn_bfs()
+        .join()
+    )
+    assert (c.state_count(), c.unique_state_count()) == (
+        h.state_count(),
+        h.unique_state_count(),
+    )
+    assert "linearizable" not in c.discoveries()

@@ -72,8 +72,12 @@ def test_interleaving_tables_shape_and_uniqueness(T, slots):
 
 
 def test_pattern_cap_raises_with_pointer_to_host_verified():
+    # 4x2 (369,600) is device-exact since round 4 (chunked scan); the
+    # refusal bound is now MAX_PATTERNS_EXACT — 5x2 = 1.68e8 exceeds it.
     b = LayoutBuilder()
-    hist = BoundedHistory(b, thread_ids=[0, 1, 2, 3], max_ops=2, op_bits=3, ret_bits=3)
+    hist = BoundedHistory(
+        b, thread_ids=[0, 1, 2, 3, 4], max_ops=2, op_bits=3, ret_bits=3
+    )
     hist.bind(b.finish())
     words = np.zeros(hist.layout.words, dtype=np.uint32)
     with pytest.raises(NotImplementedError, match="host_verified_properties"):
@@ -135,7 +139,17 @@ def _device_verdicts(histories, T, M, op_bits, ret_bits, op_code, ret_code, spec
     return np.asarray(fn(jnp.asarray(words)))
 
 
-@pytest.mark.parametrize("T,M,trials", [(2, 2, 250), (3, 2, 250), (3, 3, 40)])
+@pytest.mark.parametrize(
+    "T,M,trials",
+    [
+        (2, 2, 250),
+        (3, 2, 250),
+        (3, 3, 40),
+        # 4x2 = 369,600 patterns: exercises the round-4 CHUNKED (lax.scan)
+        # exact path — past the single-shot MAX_PATTERNS budget.
+        (4, 2, 8),
+    ],
+)
 @pytest.mark.parametrize("real_time", [True, False], ids=["lin", "seqcst"])
 def test_register_fuzz_matches_host_serializer(T, M, trials, real_time):
     rng = random.Random(10_000 * T + 100 * M + real_time)
