@@ -44,7 +44,16 @@ class CheckerBuilder:
         return BfsChecker(self)
 
     def spawn_dfs(self) -> Checker:
-        """Depth-first search; smaller frontier (checker.rs:187)."""
+        """Depth-first search; smaller frontier (checker.rs:187). With
+        ``threads(n)`` for n > 1 (and no visitor — visitors observe
+        per-state paths sequentially, so they fall back to the sequential
+        engine exactly as ``spawn_bfs`` does), the job-market parallel
+        DFS — the reference's default CLI discipline (dfs.rs:42,
+        92-215)."""
+        if (self._thread_count or 1) > 1 and self._visitor is None:
+            from .parallel_dfs import ParallelDfsChecker
+
+            return ParallelDfsChecker(self)
         from .search import DfsChecker
 
         return DfsChecker(self)
@@ -138,9 +147,11 @@ class CheckerBuilder:
     def threads(self, thread_count: int) -> "CheckerBuilder":
         """Worker count for the host engines (checker.rs:234). With n > 1,
         ``spawn_bfs`` runs the multiprocess level-synchronous engine
-        (``stateright_tpu.checker.parallel_host``); DFS stays sequential
-        (its massive parallel form in this framework is the XLA engine,
-        which uses every core of every chip regardless of this setting)."""
+        (``stateright_tpu.checker.parallel_host``) and ``spawn_dfs`` the
+        job-market parallel DFS (``stateright_tpu.checker.parallel_dfs``);
+        with a visitor both fall back to their sequential engines. The
+        massively parallel form in this framework is the XLA engine, which
+        uses every core of every chip regardless of this setting."""
         self._thread_count = thread_count
         return self
 

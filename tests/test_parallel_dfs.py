@@ -1,0 +1,126 @@
+"""Job-market parallel DFS (``threads(n)`` + ``spawn_dfs``) vs the
+sequential oracle.
+
+Full-coverage counts are engine-invariant (every unique state expands
+exactly once); visit order and early-exit timing are scheduling-dependent,
+exactly as in the reference's racing worker threads (dfs.rs:92-215), so
+count assertions here use full-coverage configurations.
+"""
+
+import pytest
+
+from stateright_tpu.checker.parallel_dfs import ParallelDfsChecker
+from stateright_tpu.core import Property
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.test_util import DGraph, LinearEquation
+
+
+def test_threads_dispatches_to_parallel_dfs():
+    c = TwoPhaseSys(3).checker().threads(3).spawn_dfs()
+    assert isinstance(c, ParallelDfsChecker)
+    c.join()
+    assert c.unique_state_count() == 288
+
+
+def test_parallel_dfs_full_coverage_parity():
+    seq = TwoPhaseSys(3).checker().spawn_dfs().join()
+    par = TwoPhaseSys(3).checker().threads(4).spawn_dfs().join()
+    assert par.unique_state_count() == seq.unique_state_count() == 288
+    assert par.state_count() == seq.state_count()
+    assert par.max_depth() == seq.max_depth()
+    assert set(par.discoveries()) == set(seq.discoveries())
+    par.assert_properties()
+
+
+def test_parallel_dfs_witnesses_are_valid():
+    par = TwoPhaseSys(3).checker().threads(3).spawn_dfs().join()
+    for name, path in par.discoveries().items():
+        # Witness paths need not be depth-minimal (DFS), but must replay
+        # from init to a state with the discovered property.
+        par.assert_discovery(name, path.into_actions())
+
+
+def test_parallel_dfs_symmetry_sound():
+    # Canonicalization under racing workers is sound but class-choice is
+    # scheduling-dependent (as in dfs.rs:357-366): the reduction must
+    # reduce and find the same discovery set, counts may vary run-to-run.
+    seq = TwoPhaseSys(3).checker().spawn_dfs().join()
+    s = TwoPhaseSys(3).checker().threads(3).symmetry().spawn_dfs().join()
+    assert s.unique_state_count() < 288
+    assert set(s.discoveries()) == set(seq.discoveries())
+
+
+def test_parallel_dfs_eventually_terminal_counterexample():
+    # A cycle-free terminal even node violates the eventually property;
+    # the parallel engine surfaces the same counterexample class.
+    graph = (
+        DGraph.with_property(Property.eventually("odd", lambda _, s: s % 2 == 1))
+        .with_path([0, 2, 4])
+        .with_path([4, 6])
+    )
+    c = graph.checker().threads(2).spawn_dfs().join()
+    assert "odd" in c.discoveries()
+
+
+def test_parallel_dfs_target_state_count_stops_early():
+    # Unsatisfiable parity: no discovery can end the search early, so the
+    # state-count target is what stops it.
+    c = (
+        LinearEquation(2, 2, 1)
+        .checker()
+        .target_state_count(1000)
+        .threads(3)
+        .spawn_dfs()
+        .join()
+    )
+    assert c.state_count() >= 1000
+    # well short of the 65,536-state full space
+    assert c.state_count() < 10_000
+
+
+def test_parallel_dfs_linear_equation_full_space():
+    # The 65,536-state full-enumeration anchor (bfs.rs:502): a solution
+    # exists, so the search early-exits on discovery; with no solution
+    # (unsatisfiable parity) it must sweep the whole space.
+    sat = LinearEquation(2, 10, 14).checker().threads(3).spawn_dfs().join()
+    assert "solvable" in sat.discoveries()
+    unsat = LinearEquation(2, 2, 1).checker().threads(3).spawn_dfs().join()
+    assert "solvable" not in unsat.discoveries()
+    assert unsat.unique_state_count() == 65_536
+
+
+def test_parallel_dfs_discovery_survives_target_trip():
+    # A violation found on the very state whose expansion trips the
+    # state-count target must still be reported (review regression).
+    graph = DGraph.with_property(
+        Property.always("small", lambda _, s: s < 7)
+    ).with_path(list(range(10)))
+    seq = graph.checker().target_state_count(9).spawn_dfs().join()
+    par = graph.checker().target_state_count(9).threads(2).spawn_dfs().join()
+    assert "small" in seq.discoveries()
+    assert "small" in par.discoveries()
+
+
+def test_parallel_dfs_duplicate_init_state_count_parity():
+    # The oracle expands every seeded init, duplicates included; the
+    # parallel engine must match full-coverage generated counts exactly
+    # (review regression).
+    graph = DGraph.with_property(
+        Property.always("hold", lambda _, s: True)
+    ).with_path(list(range(10)))
+    base_inits = graph.init_states()
+    graph.init_states = lambda: base_inits * 2  # duplicate init states
+    seq = graph.checker().spawn_dfs().join()
+    par = graph.checker().threads(2).spawn_dfs().join()
+    assert par.state_count() == seq.state_count()
+    assert par.unique_state_count() == seq.unique_state_count()
+
+
+def test_parallel_dfs_zero_property_model_stops_after_one_state():
+    # With zero properties nothing awaits a discovery: one state is
+    # evaluated, then the search stops (bfs.rs:326-328; review regression).
+    graph = DGraph().with_path(list(range(50)))
+    seq = graph.checker().spawn_dfs().join()
+    par = graph.checker().threads(2).spawn_dfs().join()
+    assert par.unique_state_count() == seq.unique_state_count()
+    assert par.unique_state_count() < 50
