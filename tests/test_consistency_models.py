@@ -6,10 +6,10 @@ budget.
 The reference defines ``SequentialConsistencyTester``
 (sequential_consistency.rs:53-241) but wires no example to it; here the
 single-copy register runs under either tester, on both engines, with parity
-between them. Client counts beyond the interleaving budget
-(``semantics.device.MAX_PATTERNS``) exercise the engine's
-``host_verified_properties`` path with a diverse-subsample conservative
-predicate — its first real (non-synthetic) customer.
+between them. ``device_exact=False`` (the default past
+``semantics.device.MAX_PATTERNS_EXACT``, i.e. 5+ clients) exercises the
+engine's ``host_verified_properties`` path with a diverse-subsample
+conservative predicate — its first real (non-synthetic) customer.
 """
 
 import pytest

@@ -27,7 +27,12 @@ def test_parallel_dfs_full_coverage_parity():
     par = TwoPhaseSys(3).checker().threads(4).spawn_dfs().join()
     assert par.unique_state_count() == seq.unique_state_count() == 288
     assert par.state_count() == seq.state_count()
-    assert par.max_depth() == seq.max_depth()
+    # max_depth is first-visit depth: scheduling-dependent under parallel
+    # DFS, bounded below by the BFS eccentricity (11 for 2pc(3)). The
+    # sequential engine's visit order is deterministic, so its depth stays
+    # pinned exactly.
+    assert seq.max_depth() == 11
+    assert par.max_depth() >= 11
     assert set(par.discoveries()) == set(seq.discoveries())
     par.assert_properties()
 

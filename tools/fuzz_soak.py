@@ -85,6 +85,18 @@ def graph_batch(seed0: int, n: int) -> int:
             par = g.checker().threads(3).spawn_bfs().join()
             got = (par.state_count(), par.unique_state_count(), par.max_depth())
             assert got == expect, f"seed {seed}: threads {got} != {expect}"
+        if seed % 8 == 4:
+            # Job-market parallel DFS (round 4): full-coverage COUNTS are
+            # engine-invariant (the fuzz graphs carry an undiscoverable
+            # property, so every run sweeps the space); max_depth is
+            # first-visit depth — visit-order-dependent under DFS — and is
+            # only bounded below by the BFS eccentricity.
+            pdf = g.checker().threads(3).spawn_dfs().join()
+            got = (pdf.state_count(), pdf.unique_state_count())
+            assert got == expect[:2], f"seed {seed}: threads-dfs {got} != {expect[:2]}"
+            assert pdf.max_depth() >= expect[2], (
+                f"seed {seed}: threads-dfs depth {pdf.max_depth()} < BFS {expect[2]}"
+            )
     return n
 
 
@@ -122,7 +134,10 @@ def semantics_batch(seed0: int, trials: int) -> int:
     )
 
     total = 0
-    for T, M in ((2, 2), (3, 2), (2, 3), (3, 3)):
+    # (4, 2) exercises the round-4 CHUNKED exact path (369,600 patterns
+    # under lax.scan); fewer trials — each history is ~200x a 3x2 check.
+    for T, M in ((2, 2), (3, 2), (2, 3), (3, 3), (4, 2)):
+        t_trials = trials if T < 4 else max(2, trials // 20)
         for spec_name in ("register", "wo"):
             for real_time in (True, False):
                 rng = random.Random(seed0 * 7919 + T * 100 + M * 10 + real_time)
@@ -148,7 +163,7 @@ def semantics_batch(seed0: int, trials: int) -> int:
                 )
                 testers = [
                     _replay(_random_events(rng, T, M, ops_of, rets_of), make())
-                    for _ in range(trials)
+                    for _ in range(t_trials)
                 ]
                 got = _device_verdicts(
                     testers, T, M, 3, 3, op_code, ret_code, spec, real_time
@@ -160,7 +175,7 @@ def semantics_batch(seed0: int, trials: int) -> int:
                     f"{spec_name} T={T} M={M} rt={real_time}: "
                     f"{int(np.sum(got != want))} disagreements"
                 )
-                total += trials
+                total += t_trials
     return total
 
 
@@ -181,7 +196,7 @@ def main() -> None:
             flush=True,
         )
     print(
-        f"[fuzz_soak] DONE: {graphs} random graphs x 6 engine configs and {sems} "
+        f"[fuzz_soak] DONE: {graphs} random graphs x 7 engine configs and {sems} "
         f"random histories x device-vs-host serializers, zero disagreements "
         f"in {time.monotonic()-t0:.0f}s",
         flush=True,
