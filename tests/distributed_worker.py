@@ -19,6 +19,11 @@ beyond one configuration):
 - ``ev``: a DGraph cycle with an EVENTUALLY property — terminal-detection
   semantics plus reconstruction of the eventually-counterexample path
   across non-addressable parent-map shards.
+- ``hv``: the host-verified-property path across the process boundary —
+  the single-copy register's linearizability forced through the
+  conservative-predicate machinery, with the stale-read counterexample
+  confirmed on host from candidate buffers allgathered over the DCN
+  transport (``_host_read`` on arrays spanning non-addressable shards).
 """
 
 import os
@@ -60,6 +65,12 @@ def main() -> None:
             # tier grows, all across the process boundary.
             kwargs.update(dedup="delta", table_capacity=1 << 9)
         builder = PackedTwoPhaseSys(3).checker()
+    elif config == "hv":
+        from stateright_tpu.models.single_copy_register import (
+            PackedSingleCopyRegister,
+        )
+
+        builder = PackedSingleCopyRegister(2, 2, device_exact=False).checker()
     elif config == "ev":
         from stateright_tpu.core import Property
         from stateright_tpu.test_util import DGraph, PackedDGraph

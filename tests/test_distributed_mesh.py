@@ -129,3 +129,34 @@ def test_two_process_mesh_eventually_counterexample():
         f"states={single.state_count()} unique={single.unique_state_count()} "
         f"depth={single.max_depth()} paths={expected_paths}"
     )
+
+
+def test_two_process_mesh_host_verified_counterexample():
+    # The host-verified-property path across a REAL process boundary: each
+    # process compacts candidates on its own shards, the confirmation
+    # reads buffers allgathered over the DCN transport, and both processes
+    # agree on the confirmed counterexample. Parity target is the
+    # single-PROCESS 8-device mesh running the identical config.
+    from stateright_tpu.models.single_copy_register import (
+        PackedSingleCopyRegister,
+    )
+    from stateright_tpu.parallel import default_mesh
+
+    local = (
+        PackedSingleCopyRegister(2, 2, device_exact=False)
+        .checker()
+        .spawn_xla(
+            mesh=default_mesh(8),
+            frontier_capacity=1 << 9,
+            table_capacity=1 << 12,
+        )
+        .join()
+    )
+    assert "linearizable" in local.discoveries()
+    expected_paths = ";".join(
+        f"{name}:{len(path)}" for name, path in sorted(local.discoveries().items())
+    )
+    assert _run_two_process("hv") == (
+        f"states={local.state_count()} unique={local.unique_state_count()} "
+        f"depth={local.max_depth()} paths={expected_paths}"
+    )
