@@ -6,7 +6,9 @@ count agreement), plus device-serializer fuzz vs the host backtracking testers
 at several (threads, ops, spec, consistency) shapes. Any disagreement is a
 real bug; the run prints one PASS/FAIL line per batch and a final summary.
 
-Usage: python tools/fuzz_soak.py [budget_seconds] (CPU backend forced).
+Usage: python tools/fuzz_soak.py [budget_seconds] [seed_base]
+(CPU backend forced; seed_base defaults to 10000 — pass a different base
+to cover fresh graphs/histories instead of repeating the standard run).
 """
 
 from __future__ import annotations
@@ -184,11 +186,12 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     budget = float(sys.argv[1]) if len(sys.argv) > 1 else 1800.0
+    seed_base = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     t0 = time.monotonic()
     graphs = sems = batch = 0
     while time.monotonic() - t0 < budget:
-        graphs += graph_batch(10_000 + batch * 16, 16)
-        sems += semantics_batch(batch, 60)
+        graphs += graph_batch(seed_base + batch * 16, 16)
+        sems += semantics_batch(seed_base + batch, 60)
         batch += 1
         print(
             f"[fuzz_soak] batch {batch}: {graphs} graphs, {sems} histories, "
