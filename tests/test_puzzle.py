@@ -1,0 +1,64 @@
+"""Sliding puzzle — the reference's first-model doc example (lib.rs:40-115).
+
+The doc-test assertions from the reference are pinned here: the doc board
+``[1,4,2,3,5,8,6,7,0]`` has a solution, discovered and validated via
+``assert_discovery`` with the exact 4-slide path (lib.rs:97-115). The
+packed form is parity-checked against the host oracle at full coverage on
+an unsolvable 2x2 board (the ``sometimes`` property never fires, so both
+engines sweep the whole 12-state component instead of early-stopping at
+the discovery, which they do at different granularity: the host oracle
+mid-level, the device engine level-synchronously).
+"""
+
+import pytest
+
+from stateright_tpu.models.puzzle import PackedPuzzle, Puzzle
+
+DOC_BOARD = [1, 4, 2, 3, 5, 8, 6, 7, 0]
+DOC_SOLUTION = ["Down", "Right", "Down", "Right"]
+
+
+def test_doc_board_discovery_host():
+    checker = Puzzle(DOC_BOARD).checker().spawn_bfs().join()
+    checker.assert_properties()
+    checker.assert_discovery("solved", DOC_SOLUTION)
+
+
+def test_doc_board_discovery_device():
+    checker = (
+        PackedPuzzle(DOC_BOARD)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 16)
+    )
+    while not checker.is_done():
+        checker._run_block()
+    checker.assert_properties()
+    checker.assert_discovery("solved", DOC_SOLUTION)
+
+
+def test_wrong_solution_rejected():
+    checker = Puzzle(DOC_BOARD).checker().spawn_bfs().join()
+    with pytest.raises(AssertionError):
+        checker.assert_discovery("solved", ["Down", "Down"])
+
+
+def test_2x2_unsolvable_full_coverage_parity():
+    bad = [0, 2, 1, 3]  # the other 12-state component: solved unreachable
+    host = Puzzle(bad, side=2).checker().spawn_bfs().join()
+    dev = (
+        PackedPuzzle(bad, side=2)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 8, table_capacity=1 << 10)
+    )
+    while not dev.is_done():
+        dev._run_block()
+    assert (host.state_count(), host.unique_state_count()) == (25, 12)
+    assert (dev.state_count(), dev.unique_state_count()) == (25, 12)
+    assert host.discovery("solved") is None
+    assert dev.discovery("solved") is None
+
+
+def test_pack_roundtrip():
+    m = PackedPuzzle(DOC_BOARD)
+    for s in (tuple(DOC_BOARD), tuple(range(9))):
+        assert m.unpack(m.pack(s)) == s
