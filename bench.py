@@ -51,7 +51,7 @@ EXPECTED_2PC = {
     3: (1_146, 288),
     4: (8_258, 1_568),
     5: (58_146, 8_832),
-    6: (402_305, 50_816),
+    6: (402_306, 50_816),
     7: (2_744_706, 296_448),
     8: (18_507_778, 1_745_408),
 }
@@ -313,8 +313,16 @@ def _worker(platform: str) -> None:
     default_warm = "600" if platform == "cpu" else "1500"
     warm_budget = float(os.environ.get("BENCH_WARM_BUDGET_S", default_warm))
     measure_budget = float(os.environ.get("BENCH_MEASURE_BUDGET_S", "300"))
+    # The primary metric is STEADY-STATE throughput (the warm budget
+    # absorbs compiles), so the flagship pins the "ramp" ladder: every
+    # level runs at its snug bucket, no jump-padding on the measured
+    # pass. The matrix rows below keep the engine default ("jump"),
+    # which optimizes their metric — time-to-full-coverage including
+    # compiles. BENCH_LADDER overrides for the on-chip A/B.
     spawn_kwargs = dict(
-        frontier_capacity=1 << frontier_pow, table_capacity=1 << table_pow
+        frontier_capacity=1 << frontier_pow,
+        table_capacity=1 << table_pow,
+        ladder=os.environ.get("BENCH_LADDER", "ramp"),
     )
     # Visited-set structure override (the on-chip A/B: sorted vs delta);
     # default "auto" = hash on CPU, sorted on accelerators.
@@ -381,6 +389,7 @@ def _worker(platform: str) -> None:
                 {
                     "platform": platform,
                     "rm": rm,
+                    "table_capacity": checker._table.capacity,
                     "generated_states": states,
                     "unique_states": checker.unique_state_count(),
                     "max_depth": checker.max_depth(),

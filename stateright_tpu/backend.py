@@ -30,6 +30,15 @@ def ensure_live_backend(timeout_s: int = 45) -> str:
     The probe subprocess pays the full plugin initialization; a healthy
     accelerator answers in a few seconds, a wedged tunnel burns the
     timeout once, and either way the CLI never hangs.
+
+    **Residual hang window (TOCTOU, ADVICE r4):** on probe success the
+    CLI initializes the accelerator plugin *itself* with no watchdog — a
+    tunnel that wedges between the probe and that first real backend use
+    still hangs the process. Accepted for the CLIs: the window is
+    seconds wide and a wedge there would have hung the probe moments
+    later anyway on the next level dispatch, which no in-process guard
+    can prevent (only whole-run subprocess watchdogs can — bench.py's
+    pattern; use it for anything unattended).
     """
     probe = (
         "import jax; ds = jax.devices(); print('PLATFORM', ds[0].platform)"

@@ -160,7 +160,15 @@ class ParallelDfsChecker(Checker):
                     # one condition-variable acquire per batch, not per
                     # state.
                     pops += 1
-                    if len(stack) > 1 and pops % 8 == 1:
+                    # Unlocked fullness pre-check (benign stale read under
+                    # CPython, ADVICE r4): a full market skips the cv
+                    # acquire entirely; the locked re-check stays
+                    # authoritative.
+                    if (
+                        len(stack) > 1
+                        and pops % 8 == 1
+                        and len(self._market) < market_low
+                    ):
                         with self._cv:
                             if len(self._market) < market_low:
                                 half = stack[: len(stack) // 2]

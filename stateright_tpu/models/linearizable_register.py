@@ -1295,7 +1295,12 @@ def main(argv=None) -> None:
         client_count = int(args.pop(0)) if args else 2
         netname = args.pop(0) if args else None
         network = Network.from_name(netname) if netname else None
-        if client_count in (2, 3) and netname in (None, "ordered"):
+        # "unordered_nonduplicating" IS the packed models' default network:
+        # spelling it out must route to the same device check as omitting
+        # it, not change the shape under the user (ADVICE r4).
+        if client_count in (2, 3) and netname in (
+            None, "unordered_nonduplicating", "ordered",
+        ):
             from ..backend import ensure_live_backend
 
             ensure_live_backend()
@@ -1303,7 +1308,7 @@ def main(argv=None) -> None:
             print(
                 f"Model checking a linearizable register with {client_count} "
                 f"clients and 2 servers on XLA"
-                + (" (ordered network)." if netname else ".")
+                + (" (ordered network)." if netname == "ordered" else ".")
             )
             (
                 cls(client_count, 2)
@@ -1365,7 +1370,9 @@ def main(argv=None) -> None:
         )
     else:
         print("USAGE:")
-        print("  linearizable-register check [CLIENT_COUNT] [NETWORK]  (device/XLA engine for 2-3 clients)")
+        print("  linearizable-register check [CLIENT_COUNT] [NETWORK]  (device/XLA engine for 2-3 clients")
+        print("      at the reference test shape, 2 servers; other shapes/networks fall back to the")
+        print("      host oracle at the reference CLI's 3-server shape)")
         print("  linearizable-register check-host [CLIENT_COUNT] [NETWORK]  (sequential host oracle)")
         print("  linearizable-register check-xla   (alias of check)")
         print("  linearizable-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")

@@ -879,6 +879,7 @@ class ShardedXlaChecker(Checker):
         W = self._W
         n_hv = len(self._hv_idx)
         hv_cap = self._hv_cap
+        hv_idx = list(self._hv_idx)  # slot j <-> property hv_idx[j]
         hv_pos = {i: j for j, i in enumerate(self._hv_idx)}
 
         def fused(frontier, f_ebits, count, table, disc_found, disc_fp,
@@ -935,6 +936,16 @@ class ShardedXlaChecker(Checker):
                 if n_hv:
                     rows = jnp.arange(hv_cap)
                     new_w, new_f = hv_w, hv_f
+                    # A property the host already confirmed collects
+                    # nothing: without this mask the accumulators keep
+                    # growing for confirmed properties and rows past
+                    # hv_cap are dropped silently — harmless only while
+                    # _confirm_hv_candidates skips confirmed props, a
+                    # coupling no future consumer should inherit
+                    # (ADVICE r4).
+                    lc = lc * jnp.stack(
+                        [(~host_found[i]).astype(lc.dtype) for i in hv_idx]
+                    )[:, None]
                     for j in range(n_hv):
                         dst = hv_c[j, 0] + rows
                         ok = (rows < lc[j, 0]) & (dst < hv_cap)

@@ -1,0 +1,68 @@
+"""Bucket-ladder policy: "jump" (growth-extrapolated rung skipping) vs
+"ramp" (one power-of-four rung per overflow).
+
+Each distinct run bucket is a separate XLA compilation of the full
+superstep program, and compile cost is dominated by program complexity,
+not bucket size (round-5 measurement: paxos 2c/3s ~11 s/bucket on 1-core
+CPU at every bucket from 64 to 4096) — so skipped rungs are pure
+time-to-first-result savings. Counts are bucket-independent: both
+policies must land the pinned exact counts.
+"""
+
+import pytest
+
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+
+def _run(ladder, model, **kw):
+    checker = model.checker().spawn_xla(ladder=ladder, **kw)
+    while not checker.is_done():
+        checker._run_block()
+    return checker
+
+
+KW = dict(frontier_capacity=1 << 12, table_capacity=1 << 14)
+
+
+def test_jump_compiles_fewer_buckets_same_counts():
+    ramp = _run("ramp", PackedTwoPhaseSys(4), **KW)
+    jump = _run("jump", PackedTwoPhaseSys(4), **KW)
+    pinned = (8_258, 1_568)
+    assert (ramp.state_count(), ramp.unique_state_count()) == pinned
+    assert (jump.state_count(), jump.unique_state_count()) == pinned
+    ramp_buckets = ramp._compiled_run_caps()
+    jump_buckets = jump._compiled_run_caps()
+    assert len(jump_buckets) < len(ramp_buckets), (jump_buckets, ramp_buckets)
+
+
+def test_second_pass_compiles_nothing_new():
+    """The measured pass must ride the warm pass's compilations: same
+    model, same policy => the bucket set cannot grow on pass 2."""
+    model = PackedTwoPhaseSys(4)
+    warm = _run("jump", model, **KW)
+    warm_buckets = set(warm._compiled_run_caps())
+    measured = _run("jump", model, **KW)
+    assert set(measured._compiled_run_caps()) == warm_buckets
+    assert (measured.state_count(), measured.unique_state_count()) == (8_258, 1_568)
+
+
+def test_deep_narrow_space_stays_on_the_floor_bucket():
+    """A space that never widens past the 64-row floor must not jump:
+    the floor-64 win for consistency-tester shapes (round 4) is invariant
+    under the ladder policy."""
+    from stateright_tpu.models.increment_lock import PackedIncrementLock
+
+    for ladder in ("ramp", "jump"):
+        checker = _run(
+            ladder,
+            PackedIncrementLock(3),
+            frontier_capacity=1 << 10,
+            table_capacity=1 << 13,
+        )
+        assert checker._compiled_run_caps() == {64}
+        assert checker.state_count() == 61
+
+
+def test_ladder_validation():
+    with pytest.raises(ValueError, match="ladder"):
+        PackedTwoPhaseSys(3).checker().spawn_xla(ladder="sideways", **KW)

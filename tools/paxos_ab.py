@@ -34,7 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PINNED = {
     "paxos 2c/3s": (32_971, 16_668),
-    "2pc rm=6": (402_305, 50_816),
+    "2pc rm=6": (402_306, 50_816),
 }
 
 

@@ -1,36 +1,161 @@
-"""Bandwidth accounting for a measured device-engine run.
+"""Bandwidth accounting + modeled ceiling for the device engine.
 
-The checker is sort/bandwidth-bound (no MXU math), so the honest
-"roofline" is HBM traffic: for each committed BFS level this tool
-computes the LOGICAL bytes each pipeline stage must move at least once
+Two modes:
 
-  expand    frontier read + plane-major grid write        (F*W + A*F*W) * 4
-  compact   fused-key sort of the grid + candidate pull   (A*F*(4+4)  + M_lanes) * ~1
-  insert    sort of [table_bucket + cand] key planes      (C + M) * 12 (3 ops)
-  frontier  survivor pull into the next frontier          M * (W+1) * 4
+``python tools/roofline.py [bench_detail.json]``
+    Post-hoc accounting of a measured run (as before): logical bytes per
+    stage divided by measured wall-clock, reported against the chip's
+    HBM peak. Numbers far below peak mean latency/serialization bound,
+    not traffic bound.
 
-and divides by the measured wall-clock to report achieved GB/s against
-the chip's peak (v5e ~819 GB/s HBM). Numbers well below peak mean the
-stage is latency/serialization-bound (the scatter story), not traffic-
-bound; sort stages legitimately move the data ~log passes, so their
-achieved "logical" bandwidth reads low by that factor — the point of the
-table is the RATIO between stages and runs, not absolute MFU.
+``python tools/roofline.py --model [bench_detail.json]``
+    The DESIGN's traffic-bound ceiling on v5e-1 (VERDICT r4 item 3): for
+    each committed level of the recorded schedule, the minimum HBM bytes
+    each stage must move, divided by an achievable fraction of peak
+    bandwidth, plus per-level dispatch latency and the measured sort
+    constant. This is what the engine would run at if every stage hit
+    ``EFFICIENCY`` of peak — the gap between this and a measured run is
+    the optimization headroom; the stage with the largest modeled share
+    is the binding constraint. Overridables (env):
+      ROOFLINE_EFFICIENCY   fraction of peak HBM each stage can achieve
+                            (default 0.4 — sorts move data ~log passes,
+                            gathers stride; 40% of peak is a strong
+                            sustained figure for this mix)
+      ROOFLINE_SORT_PASSES  effective full-data passes per bitonic-style
+                            device sort (default 3; measured two-key sort
+                            at 2^22 = 3.3 ms ~= 2.9 passes at peak)
+      ROOFLINE_RTT_S        per-dispatch host latency (default 30e-6,
+                            measured round 3 over the axon tunnel)
 
-Usage: python tools/roofline.py [bench_detail.json]
+The model is deliberately *optimistic per stage* (logical bytes, no
+re-reads beyond declared passes): it is a ceiling, not a prediction.
+
+Stage byte model per level (bucket B, actions A, words W, generated M_l,
+table capacity C, candidate cap = B*A/4):
+  expand     read frontier B*W*4, write grid B*A*W*4
+  fingerprint  read grid, write 2 key lanes: B*A*(W+2)*4
+  compact    key sort B*A*8*passes + survivor gather M_l*(W+3)*4
+  insert     3-operand sort of [C + cand] rows: (C + B*A/4)*12*passes
+  frontier   survivor pull M_l*(W+1)*4
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 PEAK_GBPS = 819.0  # TPU v5e HBM
+EFFICIENCY = float(os.environ.get("ROOFLINE_EFFICIENCY", "0.4"))
+SORT_PASSES = float(os.environ.get("ROOFLINE_SORT_PASSES", "3"))
+RTT_S = float(os.environ.get("ROOFLINE_RTT_S", "30e-6"))
+
+
+def _levels(detail):
+    for block in detail.get("levels", []):
+        for lv in block.get("levels", []):
+            yield lv
+
+
+def _bucket_for(F: int, floor: int = 64) -> int:
+    bucket = floor
+    while bucket < 4 * F:
+        bucket *= 4
+    return bucket
+
+
+def _table_capacity(detail) -> int:
+    """Recorded capacity, else derived from the unique count under the
+    sorted set's 3/4-load growth rule (older bench_detail files predate
+    the table_capacity key; defaulting to 2^22 would overstate the
+    insert stage ~100x on small schedules)."""
+    if "table_capacity" in detail:
+        return detail["table_capacity"]
+    uniq = max(int(detail.get("unique_states", 0)), 1)
+    cap = 1 << 10
+    while uniq * 4 > cap * 3:
+        cap *= 2
+    return cap
+
+
+def model_ceiling(detail) -> dict:
+    """Modeled stage seconds for the recorded level schedule on v5e-1."""
+    rm = detail.get("rm", 8)
+    A = 2 + 5 * rm
+    W = 2
+    C = _table_capacity(detail)
+    bw = PEAK_GBPS * 1e9 * EFFICIENCY
+    stages = {"expand": 0.0, "fingerprint": 0.0, "compact": 0.0,
+              "insert": 0.0, "frontier": 0.0, "dispatch": 0.0}
+    gen_total = 0
+    n_levels = 0
+    for lv in _levels(detail):
+        F = max(int(lv.get("frontier", 0)), 1)
+        M = max(int(lv.get("generated", 0)), 1)
+        gen_total += M
+        n_levels += 1
+        B = _bucket_for(F)
+        grid = B * A
+        stages["expand"] += (B * W + grid * W) * 4 / bw
+        stages["fingerprint"] += grid * (W + 2) * 4 / bw
+        stages["compact"] += (grid * 8 * SORT_PASSES + M * (W + 3) * 4) / bw
+        stages["insert"] += (C + grid // 4) * 12 * SORT_PASSES / bw
+        stages["frontier"] += M * (W + 1) * 4 / bw
+    # Fused dispatch: one RTT per ~32-level block, not per level.
+    stages["dispatch"] = max(1, n_levels / 32) * RTT_S
+    total = sum(stages.values())
+    return {
+        "rm": rm, "levels": n_levels, "generated": gen_total,
+        "stage_sec": {k: round(v, 4) for k, v in stages.items()},
+        "modeled_sec": round(total, 4),
+        "ceiling_states_per_sec": round(gen_total / max(total, 1e-12), 0),
+        "binding_stage": max(stages, key=stages.get),
+        "assumptions": {
+            "efficiency": EFFICIENCY, "sort_passes": SORT_PASSES,
+            "rtt_s": RTT_S, "peak_gbps": PEAK_GBPS,
+        },
+    }
 
 
 def main() -> None:
-    path = sys.argv[1] if len(sys.argv) > 1 else "bench_detail.json"
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    path = args[0] if args else "bench_detail.json"
     with open(path) as fh:
         detail = json.load(fh)
+
+    if "--model" in sys.argv:
+        out = model_ceiling(detail)
+        print(json.dumps(out, indent=1))
+        ns_gap = 50e6 / max(out["ceiling_states_per_sec"], 1)
+        print(
+            f"# modeled ceiling {out['ceiling_states_per_sec']/1e6:.1f} M gen/s "
+            f"on this schedule (binding: {out['binding_stage']}); "
+            f"north star 50M is {ns_gap:.2f}x {'above' if ns_gap > 1 else 'below'} it"
+        )
+        # The traffic floor above is NOT what measured runs see: round-3
+        # on-chip profiling put the per-superstep FIXED cost (kernel
+        # launches, XLA:TPU serialization, tiling tax) at ~475 ms — for a
+        # 26-level run that is ~12.4 s of the measured 14.8 s, i.e. the
+        # engine is fixed-cost-bound, not traffic-bound. This sweep shows
+        # what the same schedule delivers as the fixed cost falls (the
+        # round-5 attacks: plane-major buffers, fewer fused kernels).
+        gen = out["generated"]
+        L = out["levels"]
+        traffic = out["modeled_sec"]
+        print("# fixed-cost sweep (per-level overhead -> ceiling):")
+        for label, fixed in [
+            ("r3 measured 475 ms", 0.475),
+            ("50 ms", 0.050),
+            ("5 ms", 0.005),
+            ("traffic floor only", 0.0),
+        ]:
+            total = traffic + L * fixed
+            print(
+                f"#   {label:>20}: {gen/total/1e6:8.2f} M gen/s "
+                f"({total:.3f} s total)"
+            )
+        return
+
     rm = detail.get("rm", 8)
     A = 2 + 5 * rm
     W = 2
@@ -46,10 +171,7 @@ def main() -> None:
             F = max(int(lv.get("frontier", 0)), 1)
             gen = int(lv.get("generated", 0))
             gen_total += gen
-            # run bucket: next pow4 with 4x headroom (engine policy)
-            bucket = 1024
-            while bucket < 4 * F:
-                bucket *= 4
+            bucket = _bucket_for(F, floor=1024)
             grid = bucket * A
             M = max(gen, 1)
             expand_b = (bucket * W + grid * W) * 4
