@@ -221,6 +221,35 @@ class XlaChecker(Checker):
         if ladder not in ("jump", "ramp"):
             raise ValueError(f"ladder must be 'auto', 'jump', or 'ramp': {ladder!r}")
         self._ladder = ladder
+        # Expand-stage layout (attack 2 of the BASELINE roadmap; A/B knob
+        # for the chip window). "rows" materializes the [F, A, W] grid the
+        # vmap naturally produces, then transposes to [W, A*F] planes —
+        # the intermediate has W=2 on the minor axis, i.e. the (8,128)
+        # tiling tax on its full traffic. "planes" asks the vmap to emit
+        # [A, W, F] directly (out_axes=2), keeping F minor throughout —
+        # no padded intermediate. NOT default anywhere: a transpose fused
+        # INTO a vmapped kernel is the exact shape XLA:CPU (jax 0.9.0)
+        # miscompiles (_build_superstep_planes docstring), so "planes" is
+        # for accelerator A/Bs guarded by count_ok + the table audit.
+        expand_layout = os.environ.get("STPU_EXPAND_LAYOUT", "rows")
+        if expand_layout not in ("rows", "planes"):
+            raise ValueError(
+                f"STPU_EXPAND_LAYOUT must be 'rows' or 'planes': {expand_layout!r}"
+            )
+        if expand_layout == "planes" and not self._soa:
+            # The knob only exists in the planes superstep; an A/B run on
+            # the rows-major (hash-dedup) builder would silently measure
+            # two identical programs.
+            import warnings
+
+            warnings.warn(
+                "STPU_EXPAND_LAYOUT=planes has no effect with dedup='hash' "
+                "(rows-major superstep); the knob applies to the "
+                "sorted/delta planes engine only",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+        self._expand_layout = expand_layout
 
         self._max_probes = max_probes
         self._W = model.state_words
@@ -865,17 +894,26 @@ class XlaChecker(Checker):
                 )
             )
 
-            # 2. action-grid expansion ([F, A, W] from the standard vmap;
-            #    codec overflow folded in as in rows mode).
-            nxt, valid, step_ovf = jax.vmap(step3)(frontier)
+            # 2. action-grid expansion; codec overflow folded in as in
+            #    rows mode. Layout per the STPU_EXPAND_LAYOUT knob (see
+            #    __init__): "rows" = [F, A, W] + materialized transpose,
+            #    "planes" = the vmap emits [A, W, F] with F minor.
+            if self._expand_layout == "planes":
+                nxt, valid, step_ovf = jax.vmap(step3, out_axes=(2, 0, 0))(frontier)
+            else:
+                nxt, valid, step_ovf = jax.vmap(step3)(frontier)
             codec_overflow = jnp.any(step_ovf & f_valid[:, None])
             valid = valid & f_valid[:, None]
             step_states = jnp.sum(valid, dtype=jnp.int32)
 
             # 3. flatten a-major into [W, A*F] planes (F stays on the
-            #    128-lane axis; this transpose is what XLA materializes)
-            #    and compact in state-major rank order.
-            grid = jnp.transpose(nxt, (2, 1, 0)).reshape(W, A * f_cap)
+            #    128-lane axis) and compact in state-major rank order.
+            if self._expand_layout == "planes":
+                # [A, W, F] -> [W, A, F] moves whole F-contiguous lanes:
+                # tiling-friendly, no (8,128)-padded intermediate.
+                grid = jnp.transpose(nxt, (1, 0, 2)).reshape(W, A * f_cap)
+            else:
+                grid = jnp.transpose(nxt, (2, 1, 0)).reshape(W, A * f_cap)
             vmask = valid.T.reshape(A * f_cap)
             par_hi = jnp.broadcast_to(fhi[None, :], (A, f_cap)).reshape(-1)
             par_lo = jnp.broadcast_to(flo[None, :], (A, f_cap)).reshape(-1)

@@ -1,0 +1,61 @@
+"""STPU_EXPAND_LAYOUT=planes: the expand vmap emits [A, W, F] planes
+directly (no (8,128)-padded [F, A, W] intermediate) — attack 2 of the
+BASELINE roadmap, opt-in for chip A/Bs.
+
+The layouts must be bit-identical in semantics: same counts, same
+winner election, same discoveries. "rows" stays the default because a
+transpose fused into a vmapped kernel is the shape XLA:CPU (jax 0.9.0)
+miscompiled in round 3b — these tests are the canary: if a jax upgrade
+or model kernel change trips that bug again, the exact counts break
+here, on CPU, before any chip run trusts the knob.
+"""
+
+import pytest
+
+from stateright_tpu.models.increment_lock import PackedIncrementLock
+from stateright_tpu.models.paxos import PackedPaxos
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+
+def _run(model, **kw):
+    checker = model.checker().spawn_xla(**kw)
+    while not checker.is_done():
+        checker._run_block()
+    return checker
+
+
+@pytest.mark.parametrize(
+    "name,build,kw,pinned",
+    [
+        (
+            "2pc rm=4",
+            lambda: PackedTwoPhaseSys(4),
+            dict(frontier_capacity=1 << 12, table_capacity=1 << 14, dedup="sorted"),
+            (8_258, 1_568),
+        ),
+        (
+            "paxos 2c/3s",
+            lambda: PackedPaxos(2, 3),
+            dict(frontier_capacity=1 << 12, table_capacity=1 << 16, dedup="sorted"),
+            (32_971, 16_668),
+        ),
+        (
+            "increment_lock 3t",
+            lambda: PackedIncrementLock(3),
+            dict(frontier_capacity=1 << 10, table_capacity=1 << 13, dedup="sorted"),
+            (61, 61),
+        ),
+    ],
+)
+def test_planes_expand_layout_exact_counts(monkeypatch, name, build, kw, pinned):
+    monkeypatch.setenv("STPU_EXPAND_LAYOUT", "planes")
+    checker = _run(build(), **kw)
+    assert (checker.state_count(), checker.unique_state_count()) == pinned, name
+
+
+def test_bad_layout_rejected(monkeypatch):
+    monkeypatch.setenv("STPU_EXPAND_LAYOUT", "diagonal")
+    with pytest.raises(ValueError, match="STPU_EXPAND_LAYOUT"):
+        PackedTwoPhaseSys(3).checker().spawn_xla(
+            frontier_capacity=1 << 10, table_capacity=1 << 12
+        )

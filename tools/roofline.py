@@ -81,8 +81,10 @@ def _table_capacity(detail) -> int:
 def model_ceiling(detail) -> dict:
     """Modeled stage seconds for the recorded level schedule on v5e-1."""
     rm = detail.get("rm", 8)
-    A = 2 + 5 * rm
-    W = 2
+    # Action width: explicit "actions" key wins (non-2pc models);
+    # otherwise the 2pc formula from rm.
+    A = detail.get("actions") or (2 + 5 * rm)
+    W = detail.get("state_words", 2)
     C = _table_capacity(detail)
     bw = PEAK_GBPS * 1e9 * EFFICIENCY
     stages = {"expand": 0.0, "fingerprint": 0.0, "compact": 0.0,
