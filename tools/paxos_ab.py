@@ -70,6 +70,10 @@ def run_one(name: str, build, dedup: str, **spawn_kwargs) -> dict:
         row["audit"] = audit_table(checker)
     except Exception as e:  # diagnostic path must not kill the A/B
         row["audit"] = {"error": f"{type(e).__name__}: {e}"}
+    # Per-level telemetry: on a drift, diffing this against the CPU run of
+    # the same job pinpoints the first divergent BFS level (and hence the
+    # bucket shape whose program is suspect).
+    row["levels"] = checker.level_log
     return row
 
 
@@ -90,15 +94,26 @@ def main() -> None:
     from stateright_tpu.models.paxos import PackedPaxos
 
     jobs = [
+        # Ladder is explicit in every job: the round-5 on-chip matrix saw a
+        # DEFLATED paxos count (19,024/9,546 — lost states) under the
+        # default "jump" ladder while the ramp-pinned flagship was exact in
+        # the same tunnel window, so jump-vs-ramp is itself a variable
+        # under test here, not a nuisance parameter.
         ("paxos 2c/3s", lambda: PackedPaxos(2, 3), "sorted",
-         dict(frontier_capacity=1 << 12, table_capacity=1 << 16)),
+         dict(frontier_capacity=1 << 12, table_capacity=1 << 16,
+              ladder="jump")),
+        ("paxos 2c/3s", lambda: PackedPaxos(2, 3), "sorted",
+         dict(frontier_capacity=1 << 12, table_capacity=1 << 16,
+              ladder="ramp")),
         ("paxos 2c/3s", lambda: PackedPaxos(2, 3), "hash",
          # 2^17 at the hash 1/4-load rule avoids a mid-run growth for
          # 16,668 uniques; a SECOND hash run below crosses growth on
          # purpose (the round-3 drift fired on a growth-crossing run).
-         dict(frontier_capacity=1 << 12, table_capacity=1 << 17)),
+         dict(frontier_capacity=1 << 12, table_capacity=1 << 17,
+              ladder="ramp")),
         ("paxos 2c/3s", lambda: PackedPaxos(2, 3), "hash",
-         dict(frontier_capacity=1 << 12, table_capacity=1 << 14)),
+         dict(frontier_capacity=1 << 12, table_capacity=1 << 14,
+              ladder="ramp")),
     ]
     if "--deep" in sys.argv:
         from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
