@@ -170,7 +170,7 @@ class Layout:
         mask = np.uint32((1 << f.bits) - 1) if f.bits < 32 else np.uint32(0xFFFFFFFF)
         value = _u32(value) & mask
         if f.bits == 32:
-            return words.at[f.word + idx].set(value)
+            return _word_update(words, f.word + idx, value)
         if not f.is_array:
             w = f.word
             sh = np.uint32(f.shift)
@@ -179,7 +179,7 @@ class Layout:
         w = f.word + idx // f.epw
         sh = _u32((idx % f.epw) * f.bits)
         cleared = words[w] & ~(_u32(mask) << sh)
-        return words.at[w].set(cleared | (value << sh))
+        return _word_update(words, w, cleared | (value << sh))
 
     # --- host codec --------------------------------------------------------
 
@@ -227,6 +227,33 @@ def _u32(x):
     import jax.numpy as jnp
 
     return x.astype(jnp.uint32) if hasattr(x, "astype") else jnp.uint32(x)
+
+
+def _word_update(vec, i, value):
+    """``vec`` with element ``i`` (possibly traced) replaced by ``value``,
+    WITHOUT a scatter: one-hot compare-iota + ``where`` over the (tiny)
+    vector axis.
+
+    This lowering is load-bearing for correctness on TPU. The natural
+    ``vec.at[i].set(value)`` becomes a data-dependent one-element scatter
+    inside the vmapped model kernels, and XLA:TPU silently DROPS a
+    data-dependent subset of those scatters once the vmap batch reaches
+    4096 (first seen round 5 on the paxos ``net`` presence-bit sends:
+    count-exact at every bucket <= 2048, +530 phantom uniques at 4096 —
+    ``tools/paxos_diag.py`` bisects it to this op, bit-level evidence in
+    ``tpu_paxos_diag.log``). The one-hot form is pure elementwise
+    select — the op class every backend lowers reliably — and the vectors
+    here are model words/slots (W <= ~25), so the broadcast costs nothing
+    against the scatter it replaces. Static indices take the same path;
+    XLA folds the concrete compare-iota to a static update.
+
+    The same failure family on the other backend: XLA:CPU miscompiles a
+    transpose fused into a vmapped kernel (xla.py:_build_superstep_planes,
+    round 3b). Model-kernel writes must stay in this helper."""
+    import jax.numpy as jnp
+
+    hot = jnp.arange(vec.shape[0], dtype=jnp.uint32) == _u32(i)
+    return jnp.where(hot, jnp.asarray(value, vec.dtype), vec)
 
 
 # --------------------------------------------------------------------------
@@ -316,7 +343,7 @@ class SlotMultiset:
             bumped = jnp.where(match & ~at_max, s + jnp.uint32(1), s)
         first_empty = jnp.argmin(jnp.where(present, 1, 0))  # slots sorted: empties first
         can_insert = ~present[first_empty]
-        inserted = s.at[first_empty].set(encoded)
+        inserted = _word_update(s, first_empty, encoded)
         s_new = jnp.where(has, bumped, jnp.where(can_insert, inserted, s))
         overflow = enabled & jnp.where(has, count_ovf, ~can_insert)
         s_new = jnp.where(enabled, s_new, s)
@@ -332,7 +359,7 @@ class SlotMultiset:
         si = s[i]
         last = (si & jnp.uint32(self.max_count - 1)) == 0 if self.count_bits else jnp.bool_(True)
         new_si = jnp.where(last, jnp.uint32(0), si - jnp.uint32(1))
-        s = s.at[i].set(jnp.where(enabled, new_si, si))
+        s = _word_update(s, i, jnp.where(enabled, new_si, si))
         return self._with_slots(words, s)
 
     # --- host codec --------------------------------------------------------

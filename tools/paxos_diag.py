@@ -48,7 +48,7 @@ NPZ = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "paxos_diag.npz")
 # Levels around the first observed divergences (frontier widths 26..867).
 CAPTURE_DEPTHS = tuple(range(4, 11))
-REPLAY_CAPS = (64, 256, 1024, 4096)
+REPLAY_CAPS = (64, 256, 1024, 2048, 4096)
 
 
 def _step3(model):
