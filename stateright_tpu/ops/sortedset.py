@@ -44,12 +44,21 @@ import numpy as np
 #: How ``insert`` moves the value planes and the merged keys into place:
 #: ``"gather"`` sorts 3 operands and recovers values/rows with post-sort
 #: gathers (fewest sorted bytes); ``"sort"`` carries them as sort payload
-#: operands (no random gathers — XLA:TPU's sort moves payload at
-#: permutation-network bandwidth while random gathers measured ~15x
-#: slower in the round-3 cost model, so which wins is a hardware
-#: question). Results are bit-identical; differentially tested. The env
-#: var is read at trace time so an on-chip A/B is one process restart.
-VALUES_VIA = os.environ.get("STPU_SORTEDSET_VALUES", "gather")
+#: operands (no random gathers). The round-5 on-chip A/B settled it: the
+#: sort family is 2.3x faster end-to-end on TPU (random gathers at table
+#: scale dominate the per-level cost, tpu_profile_r5.log) while gather
+#: wins on 1-core CPU — so ``"auto"`` (the default) resolves per backend
+#: at trace time. Results are bit-identical; differentially tested. The
+#: env var makes the on-chip A/B a process restart.
+VALUES_VIA = os.environ.get("STPU_SORTEDSET_VALUES", "auto")
+
+
+def _via_sort() -> bool:
+    if VALUES_VIA == "auto":
+        import jax
+
+        return jax.default_backend() != "cpu"
+    return VALUES_VIA == "sort"
 
 
 class SortedSet(NamedTuple):
@@ -136,7 +145,7 @@ def insert(
     # is one).
     ticket = jnp.arange(cap + m, dtype=jnp.int32)
 
-    via_sort = VALUES_VIA == "sort"
+    via_sort = _via_sort()
     if via_sort:
         vh = jnp.concatenate([ss.val_hi, val_hi])
         vl = jnp.concatenate([ss.val_lo, val_lo])

@@ -195,12 +195,17 @@ class XlaChecker(Checker):
         # Planes-compaction lowering: "gather" computes the permutation
         # once (one small sort) and gathers every plane by it; "sort"
         # carries the planes as sort payload operands — no random gathers,
-        # more sorted bytes. Which wins is a hardware question (the round-3
-        # cost model measured TPU random gathers ~15x below sort payload
-        # bandwidth); results are bit-identical. Env override
-        # STPU_COMPACTION makes the on-chip A/B a process restart.
+        # more sorted bytes. The round-5 on-chip A/B settled the hardware
+        # question: the sort family runs the rm=8 check 2.3x faster than
+        # the gather family on TPU (6.81s vs 15.65s, tpu_profile_r5.log —
+        # random gathers at table scale are the dominant per-level cost),
+        # while on 1-core CPU gather wins (BASELINE.md round-3 model). So
+        # "auto" resolves per backend; STPU_COMPACTION still makes the
+        # A/B a process restart.
         if compaction == "auto":
-            compaction = os.environ.get("STPU_COMPACTION", "gather")
+            compaction = os.environ.get("STPU_COMPACTION") or (
+                "gather" if jax.default_backend() == "cpu" else "sort"
+            )
         if compaction not in ("gather", "sort"):
             raise ValueError(f"compaction must be 'auto', 'gather', or 'sort': {compaction!r}")
         self._compaction = compaction

@@ -187,7 +187,7 @@ def main() -> None:
         print(f"A/B dedup={dedup} values={values_via} compaction={comp}: "
               f"warm {warm:6.1f}s measured {dt:6.2f}s "
               f"({ck.state_count()/dt/1e6:6.2f} M gen/s)", flush=True)
-    sortedset.VALUES_VIA = "gather"
+    sortedset.VALUES_VIA = "auto"
 
 
 if __name__ == "__main__":
