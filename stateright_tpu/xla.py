@@ -206,8 +206,11 @@ class XlaChecker(Checker):
             compaction = os.environ.get("STPU_COMPACTION") or (
                 "gather" if jax.default_backend() == "cpu" else "sort"
             )
-        if compaction not in ("gather", "sort"):
-            raise ValueError(f"compaction must be 'auto', 'gather', or 'sort': {compaction!r}")
+        if compaction not in ("gather", "sort", "bsearch"):
+            raise ValueError(
+                "compaction must be 'auto', 'gather', 'sort', or "
+                f"'bsearch': {compaction!r}"
+            )
         self._compaction = compaction
         # Bucket-ladder policy. "ramp" steps one power-of-four rung per
         # frontier overflow — for a space that widens to 2^19 that is 8
@@ -777,7 +780,8 @@ class XlaChecker(Checker):
             nxt, valid = out
             return nxt, valid, jnp.zeros_like(valid)
 
-        sort_compact = self._compaction == "sort"
+        compaction = self._compaction
+        sort_compact = compaction == "sort"
 
         def compact_1d(mask, cap, arrays, prio=None, rows_out=()):
             """Stream-compact lanes where ``mask`` holds into ``cap`` slots.
@@ -787,10 +791,16 @@ class XlaChecker(Checker):
             survivors come out in ascending prio order (the semantic-order
             restoration); otherwise stable in array order.
 
-            Two lowerings with identical results (``spawn_xla(compaction=)``,
+            Three lowerings with identical results (``spawn_xla(compaction=)``,
             see ``__init__``): "gather" computes the permutation once and
             gathers every plane; "sort" carries the planes as payload
-            operands of the permutation sort — no random gathers."""
+            operands of the permutation sort — no random gathers; "bsearch"
+            (stable/no-prio paths only) avoids the permutation sort
+            entirely — cumsum of the mask + a branchless binary search of
+            each output rank over it + ascending gathers, so the whole
+            compaction is scan/gather-class work. The round-5 on-chip
+            profile motivates it: at rm=8 shapes the grid-compaction sort
+            over 2^24 lanes is the largest per-level sort in the program."""
             m = mask.shape[0]
             # One fused int32 key: invalid lanes get a high bit above every
             # priority (prio < m <= 2^30 here).
@@ -817,7 +827,21 @@ class XlaChecker(Checker):
                         ("rows" if pos in rows_out else "planes", a.shape[0])
                     )
 
-            if sort_compact:
+            if compaction == "bsearch" and prio is None:
+                # Rank i's source lane = first j with cumsum(mask)[j] == i+1:
+                # one scan + log2(m) gather rounds + one ascending gather per
+                # lane. No sort, no scatter.
+                cs = jnp.cumsum(mask.astype(jnp.int32))
+                pos_idx = jnp.searchsorted(
+                    cs, jnp.arange(1, take + 1, dtype=jnp.int32), side="left"
+                )
+                pos_idx = jnp.minimum(pos_idx, m - 1)
+                smask = jnp.arange(take) < n_valid
+                slanes = [lane[pos_idx] for lane in lanes]
+            elif sort_compact or compaction == "bsearch":
+                # ("bsearch" with a prio falls back to the sort lowering —
+                # the engine's bsearch grid build emits state-major order,
+                # so no prio path stays hot under it.)
                 sorted_all = jax.lax.sort(
                     (key, *lanes), num_keys=1, is_stable=True
                 )
@@ -911,20 +935,42 @@ class XlaChecker(Checker):
             valid = valid & f_valid[:, None]
             step_states = jnp.sum(valid, dtype=jnp.int32)
 
-            # 3. flatten a-major into [W, A*F] planes (F stays on the
-            #    128-lane axis) and compact in state-major rank order.
-            if self._expand_layout == "planes":
-                # [A, W, F] -> [W, A, F] moves whole F-contiguous lanes:
-                # tiling-friendly, no (8,128)-padded intermediate.
-                grid = jnp.transpose(nxt, (1, 0, 2)).reshape(W, A * f_cap)
+            # 3. flatten the grid into [W, A*F] planes and compact in
+            #    state-major rank order. Under the sort/gather compactions
+            #    the flatten is a-major (F stays on the 128-lane axis — the
+            #    tiling-friendly transpose) and a prio key restores the
+            #    semantic order inside the compaction sort. Under "bsearch"
+            #    the flatten is state-major (k = f*A + a) so array order IS
+            #    semantic order and the compaction needs no sort at all;
+            #    the [.., F, A] intermediate's minor-axis padding is fused
+            #    away into the reshape consumer.
+            if compaction == "bsearch":
+                if self._expand_layout == "planes":
+                    grid = jnp.transpose(nxt, (1, 2, 0)).reshape(W, f_cap * A)
+                else:
+                    grid = jnp.transpose(nxt, (2, 0, 1)).reshape(W, f_cap * A)
+                vmask = valid.reshape(f_cap * A)
+                par_hi = jnp.broadcast_to(fhi[:, None], (f_cap, A)).reshape(-1)
+                par_lo = jnp.broadcast_to(flo[:, None], (f_cap, A)).reshape(-1)
+                child_ebits = jnp.broadcast_to(
+                    f_ebits[:, None], (f_cap, A)
+                ).reshape(-1)
+                prio = None
             else:
-                grid = jnp.transpose(nxt, (2, 1, 0)).reshape(W, A * f_cap)
-            vmask = valid.T.reshape(A * f_cap)
-            par_hi = jnp.broadcast_to(fhi[None, :], (A, f_cap)).reshape(-1)
-            par_lo = jnp.broadcast_to(flo[None, :], (A, f_cap)).reshape(-1)
-            child_ebits = jnp.broadcast_to(f_ebits[None, :], (A, f_cap)).reshape(-1)
-            j = jnp.arange(A * f_cap, dtype=jnp.int32)
-            prio = (j % f_cap) * A + (j // f_cap)  # semantic rank f*A + a
+                if self._expand_layout == "planes":
+                    # [A, W, F] -> [W, A, F] moves whole F-contiguous lanes:
+                    # tiling-friendly, no (8,128)-padded intermediate.
+                    grid = jnp.transpose(nxt, (1, 0, 2)).reshape(W, A * f_cap)
+                else:
+                    grid = jnp.transpose(nxt, (2, 1, 0)).reshape(W, A * f_cap)
+                vmask = valid.T.reshape(A * f_cap)
+                par_hi = jnp.broadcast_to(fhi[None, :], (A, f_cap)).reshape(-1)
+                par_lo = jnp.broadcast_to(flo[None, :], (A, f_cap)).reshape(-1)
+                child_ebits = jnp.broadcast_to(
+                    f_ebits[None, :], (A, f_cap)
+                ).reshape(-1)
+                j = jnp.arange(A * f_cap, dtype=jnp.int32)
+                prio = (j % f_cap) * A + (j // f_cap)  # semantic rank f*A + a
             (ccand, cpar_hi, cpar_lo, cebits), n_valid = compact_1d(
                 vmask, cand_cap, [grid, par_hi, par_lo, child_ebits], prio=prio
             )
