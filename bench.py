@@ -451,9 +451,18 @@ def _spawn_worker(platform: str, timeout_s: float) -> str | None:
         return None
     dt = time.monotonic() - t0
     lines = _json_lines(proc.stdout)
-    if proc.returncode != 0 or not lines:
+    if not lines:
         _log(f"{platform} worker rc={proc.returncode} in {dt:.0f}s, no JSON line")
         return None
+    if proc.returncode != 0:
+        # Killed (wedged mid-matrix and externally terminated, OOM, ...)
+        # AFTER the primary metric went out: the measurement happened —
+        # use it, exactly like the watchdog-timeout salvage above.
+        _log(
+            f"{platform} worker rc={proc.returncode} in {dt:.0f}s but the "
+            "primary metric was already out; using it"
+        )
+        return lines[0]
     _log(f"{platform} worker ok in {dt:.0f}s")
     return lines[0]
 
