@@ -195,16 +195,26 @@ class XlaChecker(Checker):
         # Planes-compaction lowering: "gather" computes the permutation
         # once (one small sort) and gathers every plane by it; "sort"
         # carries the planes as sort payload operands — no random gathers,
-        # more sorted bytes. The round-5 on-chip A/B settled the hardware
-        # question: the sort family runs the rm=8 check 2.3x faster than
-        # the gather family on TPU (6.81s vs 15.65s, tpu_profile_r5.log —
-        # random gathers at table scale are the dominant per-level cost),
-        # while on 1-core CPU gather wins (BASELINE.md round-3 model). So
-        # "auto" resolves per backend; STPU_COMPACTION still makes the
-        # A/B a process restart.
+        # more sorted bytes; "bsearch" replaces the permutation sort with
+        # cumsum + rank binary-search + ascending gathers. The round-5
+        # on-chip A/Bs settled the hardware question per shape class:
+        #   - narrow-W (2pc W=2, rm=8): sort 8.8s vs gather 15.6s vs
+        #     bsearch 29.0s measured — random gathers at table scale are
+        #     the dominant per-level cost and sort payload wins;
+        #   - wide-W (paxos W=25): the sort-mode grid compaction becomes a
+        #     W+3 = 28-operand lax.sort whose XLA:TPU *compile* stalls for
+        #     tens of minutes (two bench workers in a row), while gather
+        #     compiles in ~2 min and measures fastest (3.2s vs bsearch
+        #     4.6s);
+        #   - 1-core CPU: gather wins everywhere (round-3 model).
+        # So "auto" resolves per backend AND per model width: sort-family
+        # compaction only where its operand count stays small.
+        # STPU_COMPACTION still makes the A/B a process restart.
         if compaction == "auto":
             compaction = os.environ.get("STPU_COMPACTION") or (
-                "gather" if jax.default_backend() == "cpu" else "sort"
+                "gather"
+                if jax.default_backend() == "cpu" or model.state_words > 8
+                else "sort"
             )
         if compaction not in ("gather", "sort", "bsearch"):
             raise ValueError(
