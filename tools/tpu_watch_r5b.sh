@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Round-5b tunnel watcher: the interactive session already landed the
+# scatter-miscompile fix, the lowering-default A/Bs, the green bench, and
+# the rm=9 soak. What remains on tunnel recovery, in priority order:
+#   1. scale soak rm=10/11 + paxos 3c/3s (sorted structure; the delta
+#      structure faults the TPU runtime and stays chip-blocked)
+#   2. final bench.py — platform-resolved jump primary off the warm cache
+# Artifacts commit AFTER EACH STAGE; only files this watcher produced are
+# staged.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch_r5b.log
+log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+commit_stage() {
+  local msg=$1 f; shift
+  for f in "$@" "$LOG"; do
+    git add -f -- "$f" >>"$LOG" 2>&1 || log "artifact missing: $f"
+  done
+  git commit -q -m "$msg" >>"$LOG" 2>&1 && log "committed: $msg"
+}
+log "watcher started (pid $$)"
+while true; do
+  if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
+    log "TUNNEL UP — stage 1: scale soak (rm=10/11 + paxos 3c/3s, sorted)"
+    timeout 5400 python tools/tpu_soak.py --skip-rm9 >tpu_soak_r5b.log 2>&1
+    rc1=$?
+    log "soak rc=$rc1: $(tail -c 300 tpu_soak_r5b.log 2>/dev/null)"
+    commit_stage "TPU r5 stage 4 (resumed): scale soak rm=10/11 + paxos 3c/3s (rc=$rc1)" \
+      tpu_soak_r5b.log
+
+    log "stage 2: final bench (jump primary, warm cache)"
+    timeout 3600 python bench.py >bench_r5_final.json 2>>"$LOG"
+    rc2=$?
+    log "bench rc=$rc2: $(tail -c 300 bench_r5_final.json 2>/dev/null)"
+    commit_stage "TPU r5: final bench, jump primary (rc=$rc2)" \
+      bench_r5_final.json bench_detail.json bench_probe.log
+
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
+      log "all stages done; watcher exiting"
+      exit 0
+    fi
+    log "a stage failed; resuming watch"
+  else
+    log "tunnel down"
+  fi
+  sleep 240
+done
