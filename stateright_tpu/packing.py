@@ -229,6 +229,12 @@ def _u32(x):
     return x.astype(jnp.uint32) if hasattr(x, "astype") else jnp.uint32(x)
 
 
+#: Force the one-hot (True) or scatter (False) lowering of traced-index
+#: word writes; None resolves by backend (one-hot off-CPU). Tests pin the
+#: accelerator lowering's HLO on the CPU backend through this.
+ONE_HOT_WRITES = None
+
+
 def _word_update(vec, i, value):
     """``vec`` with element ``i`` (possibly traced) replaced by ``value``,
     WITHOUT a scatter: one-hot compare-iota + ``where`` over the (tiny)
@@ -249,9 +255,23 @@ def _word_update(vec, i, value):
 
     The same failure family on the other backend: XLA:CPU miscompiles a
     transpose fused into a vmapped kernel (xla.py:_build_superstep_planes,
-    round 3b). Model-kernel writes must stay in this helper."""
+    round 3b). Model-kernel writes must stay in this helper.
+
+    Backend-split: on CPU the one-element scatter is both correct (four
+    rounds of exact counts) and O(1), while the one-hot form pays O(W)
+    per write — measured as a multi-fold slowdown of the serializer-heavy
+    consistency tests — so CPU keeps ``.at[i].set``. Accelerators take
+    the one-hot path unconditionally. ``ONE_HOT_WRITES`` (None = by
+    backend) lets the CPU test suite pin the accelerator lowering's HLO
+    without a chip."""
+    import jax
     import jax.numpy as jnp
 
+    one_hot = ONE_HOT_WRITES
+    if one_hot is None:
+        one_hot = jax.default_backend() != "cpu"
+    if not one_hot:
+        return vec.at[i].set(jnp.asarray(value, vec.dtype))
     hot = jnp.arange(vec.shape[0], dtype=jnp.uint32) == _u32(i)
     return jnp.where(hot, jnp.asarray(value, vec.dtype), vec)
 

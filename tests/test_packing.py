@@ -406,14 +406,20 @@ def test_bounded_history_overflow_loud():
 # --- scatter-free traced-index writes --------------------------------------
 
 
-def test_word_update_is_scatter_free_and_exact():
+def test_word_update_is_scatter_free_and_exact(monkeypatch):
     """Traced-index field writes must go through the one-hot lowering
-    (packing._word_update): XLA:TPU silently drops data-dependent
-    one-element scatters inside vmapped model kernels at batch >= 4096
-    (round-5 on-chip paxos drift; bisection in tools/paxos_diag.py).
-    Pins (a) bit-exactness of Layout.set/SlotMultiset under traced
-    indices against the host pack() oracle, and (b) the absence of any
-    scatter op in the lowered HLO of a vmapped body that writes fields."""
+    (packing._word_update) on accelerators: XLA:TPU silently drops
+    data-dependent one-element scatters inside vmapped model kernels at
+    batch >= 4096 (round-5 on-chip paxos drift; bisection in
+    tools/paxos_diag.py). Pins (a) bit-exactness of Layout.set /
+    SlotMultiset under traced indices against the host pack() oracle,
+    and (b) the absence of any scatter op in the lowered HLO of a
+    vmapped field-writing body under the accelerator lowering (forced
+    here via packing.ONE_HOT_WRITES — the CPU backend keeps the O(1)
+    scatter, which is correct there)."""
+    import stateright_tpu.packing as packing
+
+    monkeypatch.setattr(packing, "ONE_HOT_WRITES", True)
     lay = (
         LayoutBuilder()
         .array("bits", 40, 1)
@@ -447,7 +453,10 @@ def test_word_update_is_scatter_free_and_exact():
     assert not re.search(r"\bscatter\(", hlo), "traced-index write lowered to a scatter"
 
 
-def test_slot_multiset_send_remove_scatter_free():
+def test_slot_multiset_send_remove_scatter_free(monkeypatch):
+    import stateright_tpu.packing as packing
+
+    monkeypatch.setattr(packing, "ONE_HOT_WRITES", True)
     b = LayoutBuilder()
     b.words("net", 4)
     lay = b.finish()
