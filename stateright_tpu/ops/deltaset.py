@@ -206,74 +206,99 @@ def insert(
     vl = val_lo[st]
 
     new_total_delta = ds.n_delta + n_win
-    need_flush = new_total_delta > Dc
-    # Overflow only on a flush that cannot fit main (the proactive growth
-    # rule at 3/4 of total capacity fires first for Dc = C/16, so this is
-    # a tiny-table / adversarial-batch safety net).
-    overflow = need_flush & (ds.n_main + new_total_delta > C)
+    # Delta-full reports as the structure's overflow: the CALLER runs the
+    # flush (``maintain``) as its own host-invoked program and retries the
+    # level through the engine's standard overflow protocol — exactly the
+    # grow-and-retry shape. The flush was originally a ``lax.cond`` branch
+    # inside this program, but a conditional carrying a main-capacity sort
+    # reproducibly FAULTS the XLA:TPU runtime ("TPU worker crashed —
+    # kernel fault", observed at both 2^22 and 2^27 main tiers, round 5),
+    # and host-side branching costs one retried level per ~(Dc / batch)
+    # levels — noise against the amortization it buys. The returned set is
+    # truncated on overflow and must be discarded, like sortedset's.
+    overflow = new_total_delta > Dc
 
-    def delta_path(_):
-        # Merge winners into the delta tier: one sort of [Dc + m].
-        dkh = jnp.concatenate(
-            [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_hi, full),
-             jnp.where(winner, skh, full)]
-        )
-        dkl = jnp.concatenate(
-            [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_lo, full),
-             jnp.where(winner, skl, full)]
-        )
-        dvh = jnp.concatenate([ds.delta_val_hi, jnp.where(winner, vh, 0)])
-        dvl = jnp.concatenate([ds.delta_val_lo, jnp.where(winner, vl, 0)])
-        mkh, mkl, mvh, mvl = jax.lax.sort((dkh, dkl, dvh, dvl), num_keys=2)
-        row_ok = jnp.arange(Dc) < jnp.minimum(new_total_delta, Dc)
-        z = jnp.uint32(0)
-        return (
-            ds.main_key_hi, ds.main_key_lo, ds.main_val_hi, ds.main_val_lo,
-            jnp.where(row_ok, mkh[:Dc], z),
-            jnp.where(row_ok, mkl[:Dc], z),
-            jnp.where(row_ok, mvh[:Dc], z),
-            jnp.where(row_ok, mvl[:Dc], z),
-            ds.n_main,
-            jnp.minimum(new_total_delta, Dc),
-        )
+    # Merge winners into the delta tier: one sort of [Dc + m].
+    dkh = jnp.concatenate(
+        [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_hi, full),
+         jnp.where(winner, skh, full)]
+    )
+    dkl = jnp.concatenate(
+        [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_lo, full),
+         jnp.where(winner, skl, full)]
+    )
+    dvh = jnp.concatenate([ds.delta_val_hi, jnp.where(winner, vh, 0)])
+    dvl = jnp.concatenate([ds.delta_val_lo, jnp.where(winner, vl, 0)])
+    mkh, mkl, mvh, mvl = jax.lax.sort((dkh, dkl, dvh, dvl), num_keys=2)
+    row_ok = jnp.arange(Dc) < jnp.minimum(new_total_delta, Dc)
+    z = jnp.uint32(0)
+    out = DeltaSet(
+        ds.main_key_hi, ds.main_key_lo, ds.main_val_hi, ds.main_val_lo,
+        jnp.where(row_ok, mkh[:Dc], z),
+        jnp.where(row_ok, mkl[:Dc], z),
+        jnp.where(row_ok, mvh[:Dc], z),
+        jnp.where(row_ok, mvl[:Dc], z),
+        ds.n_main,
+        jnp.minimum(new_total_delta, Dc),
+    )
+    return out, is_new, overflow
 
-    def flush_path(_):
-        # Fold main + delta + batch winners into main: sort [C + Dc + m].
-        mk_valid = jnp.arange(C) < ds.n_main
-        dk_valid = jnp.arange(Dc) < ds.n_delta
-        akh = jnp.concatenate(
-            [jnp.where(mk_valid, ds.main_key_hi, full),
-             jnp.where(dk_valid, ds.delta_key_hi, full),
-             jnp.where(winner, skh, full)]
-        )
-        akl = jnp.concatenate(
-            [jnp.where(mk_valid, ds.main_key_lo, full),
-             jnp.where(dk_valid, ds.delta_key_lo, full),
-             jnp.where(winner, skl, full)]
-        )
-        avh = jnp.concatenate(
-            [ds.main_val_hi, ds.delta_val_hi, jnp.where(winner, vh, 0)]
-        )
-        avl = jnp.concatenate(
-            [ds.main_val_lo, ds.delta_val_lo, jnp.where(winner, vl, 0)]
-        )
-        mkh, mkl, mvh, mvl = jax.lax.sort((akh, akl, avh, avl), num_keys=2)
-        n_new_main = ds.n_main + new_total_delta
-        row_ok = jnp.arange(C) < jnp.minimum(n_new_main, C)
-        z = jnp.uint32(0)
-        zd = jnp.zeros((Dc,), jnp.uint32)
-        return (
-            jnp.where(row_ok, mkh[:C], z),
-            jnp.where(row_ok, mkl[:C], z),
-            jnp.where(row_ok, mvh[:C], z),
-            jnp.where(row_ok, mvl[:C], z),
-            zd, zd, zd, zd,
-            jnp.minimum(n_new_main, C),
-            jnp.asarray(0, jnp.int32),
-        )
 
-    outs = jax.lax.cond(need_flush, flush_path, delta_path, operand=None)
-    return DeltaSet(*outs), is_new, overflow
+def maintain(ds: DeltaSet) -> Tuple[DeltaSet, "jax.Array"]:
+    """Fold the delta tier into main: one sort of [C + Dc], delta empties.
+    The flush half of the LSM design, as a standalone jittable program
+    (see the overflow note in :func:`insert` for why it is NOT a
+    ``lax.cond`` branch inside the insert). Returns ``(ds', overflow)``;
+    overflow means the merged set does not fit main — the caller grows
+    (``grow`` folds the delta anyway) and discards ``ds'``."""
+    import jax
+    import jax.numpy as jnp
+
+    C = ds.main_capacity
+    Dc = ds.delta_capacity
+    full = jnp.uint32(0xFFFFFFFF)
+    mk_valid = jnp.arange(C) < ds.n_main
+    dk_valid = jnp.arange(Dc) < ds.n_delta
+    akh = jnp.concatenate(
+        [jnp.where(mk_valid, ds.main_key_hi, full),
+         jnp.where(dk_valid, ds.delta_key_hi, full)]
+    )
+    akl = jnp.concatenate(
+        [jnp.where(mk_valid, ds.main_key_lo, full),
+         jnp.where(dk_valid, ds.delta_key_lo, full)]
+    )
+    avh = jnp.concatenate([ds.main_val_hi, ds.delta_val_hi])
+    avl = jnp.concatenate([ds.main_val_lo, ds.delta_val_lo])
+    mkh, mkl, mvh, mvl = jax.lax.sort((akh, akl, avh, avl), num_keys=2)
+    n_new_main = ds.n_main + ds.n_delta
+    overflow = n_new_main > C
+    row_ok = jnp.arange(C) < jnp.minimum(n_new_main, C)
+    z = jnp.uint32(0)
+    zd = jnp.zeros((Dc,), jnp.uint32)
+    out = DeltaSet(
+        jnp.where(row_ok, mkh[:C], z),
+        jnp.where(row_ok, mkl[:C], z),
+        jnp.where(row_ok, mvh[:C], z),
+        jnp.where(row_ok, mvl[:C], z),
+        zd, zd, zd, zd,
+        jnp.minimum(n_new_main, C),
+        jnp.asarray(0, jnp.int32),
+    )
+    return out, overflow
+
+
+_maintain_jitted = None
+
+
+def maintain_jit(ds: DeltaSet) -> Tuple[DeltaSet, "jax.Array"]:
+    """``maintain`` under a module-cached ``jax.jit`` (a fresh ``jax.jit``
+    wrapper per call would recompile the flush every flush)."""
+    global _maintain_jitted
+    if _maintain_jitted is None:
+        import jax
+
+        _maintain_jitted = jax.jit(maintain)
+    return _maintain_jitted(ds)
 
 
 def lookup(ds: DeltaSet, fp_hi, fp_lo, *, max_probes: int = 0):

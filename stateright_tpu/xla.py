@@ -1265,7 +1265,11 @@ class XlaChecker(Checker):
     def _grow_table_if_loaded(self) -> None:
         """Double the table whenever the committed unique count crosses the
         structure's load ceiling — BEFORE inserts start paying (hash: long
-        probe chains; sorted: an overflow-retry round trip)."""
+        probe chains; sorted: an overflow-retry round trip). For the delta
+        structure, additionally flush the delta tier proactively at 3/4
+        occupancy — a flush at a dispatch boundary costs nothing extra,
+        while one discovered mid-level costs the overflow-retry of that
+        level."""
         num, den = (
             (self.MAX_LOAD_NUM, self.MAX_LOAD_DEN)
             if self._dedup == "hash"
@@ -1273,6 +1277,28 @@ class XlaChecker(Checker):
         )
         while self._unique_count * den > self._table.capacity * num:
             self._grow_table()
+        if self._dedup == "delta":
+            ds = self._table
+            if int(ds.n_delta) * 4 > ds.delta_capacity * 3:
+                flushed, ovf = deltaset.maintain_jit(ds)
+                if bool(ovf):  # pragma: no cover - load rule fires first
+                    self._grow_table()
+                else:
+                    self._table = flushed
+
+    def _resolve_table_overflow(self) -> None:
+        """A table overflow from the structure: for the delta set a
+        non-empty delta tier means FLUSH (``deltaset.maintain``) — the
+        amortized big merge, host-invoked so no ``lax.cond`` ever carries
+        a main-capacity sort (that conditional shape faults the XLA:TPU
+        runtime; see deltaset.insert) — and only an empty-delta overflow
+        or a flush that cannot fit main grows capacity."""
+        if self._dedup == "delta" and int(self._table.n_delta) > 0:
+            flushed, ovf = deltaset.maintain_jit(self._table)
+            if not bool(ovf):
+                self._table = flushed
+                return
+        self._grow_table()
 
     def _grow_table(self) -> None:
         """Double the visited-set capacity: a rehash for the hash table, a
@@ -1563,10 +1589,10 @@ class XlaChecker(Checker):
                 self._raise_codec_overflow()
             if t_ovf:
                 # The proactive pass above may already have doubled past
-                # the blockage; only grow again if it did not (every extra
-                # doubling is 2x memory AND a fresh shape compile).
+                # the blockage; only resolve again if it did not (every
+                # extra doubling is 2x memory AND a fresh shape compile).
                 if not grew_proactively:
-                    self._grow_table()
+                    self._resolve_table_overflow()
                 continue
             if f_ovf:
                 run_cap = self._grow_frontier(run_cap)
@@ -1634,9 +1660,9 @@ class XlaChecker(Checker):
             if bool(c_ovf):
                 self._raise_codec_overflow()
             if bool(t_ovf):
-                # Functional arrays: the pre-step table is untouched; grow
-                # and re-run the same level.
-                self._grow_table()
+                # Functional arrays: the pre-step table is untouched;
+                # flush (delta) or grow, then re-run the same level.
+                self._resolve_table_overflow()
                 continue
             if bool(f_ovf):
                 run_cap = self._grow_frontier(run_cap)
