@@ -1197,9 +1197,23 @@ class XlaChecker(Checker):
                 cap = self._next_pow2(m)
             else:
                 # Power-of-two (not four): a pow4 ladder can land just
-                # above m/4 at the big buckets and erase most of the
-                # compaction win.
-                cap = max(1024, self._next_pow2(max(m // 4, 1)))
+                # above the target at the big buckets and erase most of
+                # the compaction win. The initial fraction is a guess the
+                # cc_ovf protocol self-corrects (warm pass pays the grow
+                # compiles; the measured pass replays learned caps): CPU
+                # keeps the round-2 m/4; accelerators start at m/16 —
+                # per-level cost there scales with sorted lane-words
+                # x log2^2(n) (round-5 profile), so a snugger candidate
+                # buffer directly shrinks the insert's merge sort (rm=8
+                # real peak validity is ~11% of the grid). STPU_CAND_FRAC
+                # overrides the denominator for A/Bs.
+                import jax as _jax
+
+                den = int(os.environ.get(
+                    "STPU_CAND_FRAC",
+                    "4" if _jax.default_backend() == "cpu" else "16",
+                ))
+                cap = max(1024, self._next_pow2(max(m // den, 1)))
             caps[run_cap] = cap = min(cap, self._next_pow2(m))
         return cap
 
