@@ -134,6 +134,28 @@ def main() -> None:
         table_capacity=1 << 25,
     )
 
+    # LAST, because the pre-redesign delta faulted the TPU runtime and a
+    # residual fault must not cost the stages above: the delta structure
+    # under its round-5 host-invoked-flush protocol, at rm=8 (vs the 8.7s
+    # sorted number) and rm=10 (the regime it exists for).
+    if "--no-delta-retry" not in sys.argv:
+        soak(
+            "2pc rm=8 delta (flush-protocol retry)",
+            lambda: PackedTwoPhaseSys(8),
+            frontier_capacity=1 << 19,
+            table_capacity=1 << 22,
+            dedup="delta",
+        )
+        soak(
+            "2pc rm=10 delta (flush-protocol retry)",
+            lambda: PackedTwoPhaseSys(10),
+            runs=1,
+            budget_s=1200,
+            frontier_capacity=1 << 21,
+            table_capacity=1 << 27,
+            dedup="delta",
+        )
+
 
 if __name__ == "__main__":
     main()
