@@ -224,16 +224,21 @@ def test_insert_values_via_sort_matches_gather(monkeypatch):
         assert bool(ovf_a) == bool(ovf_b)
 
 
-def test_engine_compaction_sort_matches_gather():
-    """spawn_xla(compaction="sort") (payload-through-sort planes
-    compaction) reproduces the gather engine's counts and witness paths."""
+def test_engine_compaction_lowerings_match():
+    """All three compaction lowerings — "gather", "sort" (payload through
+    the sorts, with the round-5 derived-parent grid sort), and "bsearch"
+    (cumsum + rank binary-search) — reproduce identical counts and
+    witness paths."""
     from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
 
     kw = dict(frontier_capacity=1 << 6, table_capacity=1 << 9, dedup="sorted")
     a = PackedTwoPhaseSys(3).checker().spawn_xla(compaction="gather", **kw).join()
-    b = PackedTwoPhaseSys(3).checker().spawn_xla(compaction="sort", **kw).join()
-    assert _counts(a) == _counts(b)
-    da, db = a.discoveries(), b.discoveries()
-    assert set(da) == set(db) and da
-    for name in da:
-        assert da[name].into_states() == db[name].into_states()
+    da = a.discoveries()
+    assert da
+    for mode in ("sort", "bsearch"):
+        b = PackedTwoPhaseSys(3).checker().spawn_xla(compaction=mode, **kw).join()
+        assert _counts(a) == _counts(b), mode
+        db = b.discoveries()
+        assert set(da) == set(db), mode
+        for name in da:
+            assert da[name].into_states() == db[name].into_states(), mode
