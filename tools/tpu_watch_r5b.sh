@@ -21,19 +21,20 @@ commit_stage() {
 log "watcher started (pid $$)"
 while true; do
   if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
-    log "TUNNEL UP — stage 1: scale soak (rm=10/11 + paxos 3c/3s, sorted)"
-    timeout 5400 python tools/tpu_soak.py --skip-rm9 >tpu_soak_r5b.log 2>&1
-    rc1=$?
-    log "soak rc=$rc1: $(tail -c 300 tpu_soak_r5b.log 2>/dev/null)"
-    commit_stage "TPU r5 stage 4 (resumed): scale soak rm=10/11 + paxos 3c/3s (rc=$rc1)" \
-      tpu_soak_r5b.log
-
-    log "stage 2: final bench (jump primary, warm cache)"
+    log "TUNNEL UP — stage 1: bench (headline first: the grid-sort and
+    cand-cap changes are unmeasured on chip; windows can be short)"
     timeout 3600 python bench.py >bench_r5_final.json 2>>"$LOG"
-    rc2=$?
-    log "bench rc=$rc2: $(tail -c 300 bench_r5_final.json 2>/dev/null)"
-    commit_stage "TPU r5: final bench, jump primary (rc=$rc2)" \
+    rc1=$?
+    log "bench rc=$rc1: $(tail -c 300 bench_r5_final.json 2>/dev/null)"
+    commit_stage "TPU r5: bench with derived-parent grid sort + snug cand caps (rc=$rc1)" \
       bench_r5_final.json bench_detail.json bench_probe.log
+
+    log "stage 2: scale soak (rm=10/11 + paxos 3c/3s, sorted; delta retries last)"
+    timeout 5400 python tools/tpu_soak.py --skip-rm9 >tpu_soak_r5b.log 2>&1
+    rc2=$?
+    log "soak rc=$rc2: $(tail -c 300 tpu_soak_r5b.log 2>/dev/null)"
+    commit_stage "TPU r5 stage 4 (resumed): scale soak rm=10/11 + paxos 3c/3s + delta retries (rc=$rc2)" \
+      tpu_soak_r5b.log
 
     if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ]; then
       log "all stages done; watcher exiting"
