@@ -160,6 +160,9 @@ def main() -> None:
         print(f"full check lpd={lpd}: warm {warm:6.1f}s measured {dt:6.2f}s "
               f"({ck.state_count()/dt/1e6:6.2f} M gen/s; {ck.state_count():,} gen "
               f"{ck.unique_state_count():,} uniq depth {ck.max_depth()})", flush=True)
+        if lpd != 1:
+            # Bucket choices incl. tail shrink-exits: (run_cap, committed).
+            print(f"  dispatches: {ck.dispatch_log}", flush=True)
         if lpd == 1:
             for lv, t in zip(ck.level_log, lvl_times):
                 print(f"  depth {lv['depth']:3d} frontier {lv['frontier']:9,} gen {lv['generated']:9,} uniq {lv['unique']:9,}  {t*1e3:8.1f} ms", flush=True)
@@ -171,8 +174,13 @@ def main() -> None:
     for dedup, values_via, comp in (
         ("sorted", "gather", "gather"),
         ("sorted", "sort", "sort"),
+        # Mixed families: which half of the round-5 2.3x (insert payload
+        # vs grid compaction) carries it, and whether a mix beats both.
+        ("sorted", "sort", "gather"),
+        ("sorted", "gather", "sort"),
         ("delta", "gather", "gather"),
         ("delta", "gather", "sort"),
+        ("delta", "sort", "sort"),
     ):
         sortedset.VALUES_VIA = values_via
         m3 = PackedTwoPhaseSys(rm)

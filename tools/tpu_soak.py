@@ -38,10 +38,25 @@ def main() -> None:
         results = []
         for i in range(runs):
             model = build()
+            # Announce BEFORE the first device call: the tunnel wedges
+            # (blocks forever) rather than failing, and twice now an
+            # rm=10 soak froze with zero output — the starting line is
+            # what localizes the hang to a config + run.
+            print(f"[soak] {name} run {i} starting ({kw})", flush=True)
             c = model.checker().spawn_xla(**kw)
             t0 = time.monotonic()
+            last_hb = t0
             while not c.is_done() and time.monotonic() - t0 < budget_s:
                 c._run_block()
+                now = time.monotonic()
+                if now - last_hb > 60:
+                    print(
+                        f"[soak] {name} run {i} heartbeat: "
+                        f"gen={c.state_count():,} uniq={c.unique_state_count():,} "
+                        f"depth={c.max_depth()} t={now - t0:.0f}s",
+                        flush=True,
+                    )
+                    last_hb = now
             dt = time.monotonic() - t0
             results.append(
                 (c.state_count(), c.unique_state_count(), c.max_depth(), c.is_done())

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Round-5c tunnel watcher. Context: the shrink-exit engine change (new
+# fused-program signature) has no chip number yet, and the rm=10/11 +
+# paxos 3c/3s soak plus the redesigned-delta retries have never
+# completed (two rm=10 attempts froze on tunnel wedges). On recovery:
+#   1. bench.py — headline first: the shrink-exit engine's number, with
+#      count checks + audit (windows can be short)
+#   2. profile_superstep 8 — dispatch-log + mixed-lowering A/Bs
+#   3. tpu_soak --skip-rm9 — the queued scale soak + delta retries
+# Artifacts commit AFTER EACH STAGE; only files this watcher produced
+# are staged.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch_r5c.log
+log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+commit_stage() {
+  local msg=$1 f; shift
+  for f in "$@" "$LOG"; do
+    git add -f -- "$f" >>"$LOG" 2>&1 || log "artifact missing: $f"
+  done
+  git commit -q -m "$msg" >>"$LOG" 2>&1 && log "committed: $msg"
+}
+log "watcher started (pid $$)"
+while true; do
+  if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
+    log "TUNNEL UP — stage 1: bench (shrink-exit engine, fresh fused signature)"
+    timeout 3600 python bench.py >bench_r5d_out.json 2>>"$LOG"
+    rc1=$?
+    log "bench rc=$rc1: $(tail -c 300 bench_r5d_out.json 2>/dev/null)"
+    commit_stage "TPU r5c: bench with the shrink-exit engine (rc=$rc1)" \
+      bench_r5d_out.json bench_detail.json bench_probe.log
+
+    log "stage 2: superstep profile (dispatch log + mixed lowering A/Bs)"
+    timeout 2700 python tools/profile_superstep.py 8 >tpu_profile_r5c.log 2>&1
+    rc2=$?
+    log "profile rc=$rc2"
+    commit_stage "TPU r5c: superstep profile — shrink dispatches + mixed lowering A/Bs (rc=$rc2)" \
+      tpu_profile_r5c.log
+
+    log "stage 3: scale soak rm=10/11 + paxos 3c/3s + delta retries"
+    timeout 7200 python tools/tpu_soak.py --skip-rm9 >tpu_soak_r5d.log 2>&1
+    rc3=$?
+    log "soak rc=$rc3: $(tail -c 300 tpu_soak_r5d.log 2>/dev/null)"
+    commit_stage "TPU r5c: scale soak rm=10/11 + paxos 3c/3s + delta retries (rc=$rc3)" \
+      tpu_soak_r5d.log
+
+    if [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]; then
+      log "all stages done; watcher exiting"
+      exit 0
+    fi
+    log "a stage failed; resuming watch"
+  else
+    log "tunnel down"
+  fi
+  sleep 240
+done
