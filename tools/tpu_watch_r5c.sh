@@ -30,12 +30,15 @@ while true; do
     commit_stage "TPU r5c: bench with the shrink-exit engine (rc=$rc1)" \
       bench_r5d_out.json bench_detail.json bench_probe.log
 
-    log "stage 2: superstep profile (dispatch log + mixed lowering A/Bs)"
+    log "stage 2: sort-dtype A/B (key packing) + superstep profile"
+    timeout 1200 python tools/sortbench.py 23 >tpu_sortbench.log 2>&1
+    rc2a=$?
+    log "sortbench rc=$rc2a: $(tail -c 200 tpu_sortbench.log 2>/dev/null)"
     timeout 2700 python tools/profile_superstep.py 8 >tpu_profile_r5c.log 2>&1
     rc2=$?
     log "profile rc=$rc2"
-    commit_stage "TPU r5c: superstep profile — shrink dispatches + mixed lowering A/Bs (rc=$rc2)" \
-      tpu_profile_r5c.log
+    commit_stage "TPU r5c: sortbench dtype A/B + superstep profile (rc=$rc2a/$rc2)" \
+      tpu_sortbench.log tpu_profile_r5c.log
 
     log "stage 3: scale soak rm=10/11 + paxos 3c/3s + delta retries"
     timeout 7200 python tools/tpu_soak.py --skip-rm9 >tpu_soak_r5d.log 2>&1
