@@ -181,10 +181,25 @@ def insert(
     full = jnp.uint32(0xFFFFFFFF)
 
     # --- shared prologue: candidate sort + membership + winner election --
+    # Values reach sorted-batch order either as payload operands of the
+    # prologue sort or by two random [m]-lane gathers afterwards — the
+    # same trade ``sortedset`` resolves per backend (the round-5 chip
+    # A/B: random gathers at scale lose to payload-through-sort on TPU,
+    # win on 1-core CPU). Results are bit-identical.
+    from .sortedset import _via_sort
+
     kh = jnp.where(active, fp_hi, full)
     kl = jnp.where(active, fp_lo, full)
     ticket = jnp.arange(m, dtype=jnp.int32)
-    skh, skl, st = jax.lax.sort((kh, kl, ticket), num_keys=3)
+    if _via_sort():
+        skh, skl, st, vh, vl = jax.lax.sort(
+            (kh, kl, ticket, val_hi, val_lo), num_keys=3
+        )
+    else:
+        skh, skl, st = jax.lax.sort((kh, kl, ticket), num_keys=3)
+        # Winner values, aligned with the sorted batch.
+        vh = val_hi[st]
+        vl = val_lo[st]
     run_start = jnp.concatenate(
         [jnp.ones((1,), jnp.bool_), (skh[1:] != skh[:-1]) | (skl[1:] != skl[:-1])]
     )
@@ -200,10 +215,6 @@ def insert(
     # is_new back to batch order: inverse permutation by one sort.
     _, winner_in_order = jax.lax.sort((st, winner.astype(jnp.int32)), num_keys=1)
     is_new = winner_in_order.astype(jnp.bool_)
-
-    # Winner values, aligned with the sorted batch.
-    vh = val_hi[st]
-    vl = val_lo[st]
 
     new_total_delta = ds.n_delta + n_win
     # Delta-full reports as the structure's overflow: the CALLER runs the

@@ -160,3 +160,36 @@ def test_engine_parity_delta_symmetry():
     a = PackedIncrement(3).checker().symmetry().spawn_xla(dedup="sorted").join()
     b = PackedIncrement(3).checker().symmetry().spawn_xla(dedup="delta").join()
     assert _counts(a) == _counts(b) == (27, 17, 5)
+
+
+def test_delta_insert_values_via_sort_matches_gather(monkeypatch):
+    """deltaset's prologue sort mirrors sortedset's values lowering
+    (payload-through-sort on accelerators vs post-sort gathers on CPU):
+    both must produce bit-identical tiers, is_new, and overflow."""
+    rng = np.random.default_rng(31)
+    dl_a = deltaset.make(1 << 11, jnp)
+    dl_b = deltaset.make(1 << 11, jnp)
+    for rnd in range(6):
+        hi, lo, vh, vl, act = _rand_batch(rng, 257, 300)
+        monkeypatch.setattr(sortedset, "VALUES_VIA", "gather")
+        dl_a, new_a, ovf_a = deltaset.insert(dl_a, hi, lo, vh, vl, act)
+        monkeypatch.setattr(sortedset, "VALUES_VIA", "sort")
+        dl_b, new_b, ovf_b = deltaset.insert(dl_b, hi, lo, vh, vl, act)
+        for a, b in zip(dl_a, dl_b):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), rnd
+        assert np.array_equal(np.asarray(new_a), np.asarray(new_b)), rnd
+        assert not bool(ovf_a) and not bool(ovf_b), rnd
+    # The overflow leg, for real: a shrunken delta tier (the module knob
+    # exists for exactly this) that one near-unique batch overflows. Both
+    # lowerings must report it; the returned sets are discarded per the
+    # contract.
+    monkeypatch.setattr(deltaset, "MIN_DELTA", 128)
+    hi, lo, vh, vl, act = _rand_batch(rng, 257, 2**31)
+    small_a = deltaset.make(1 << 11, jnp)
+    small_b = deltaset.make(1 << 11, jnp)
+    monkeypatch.setattr(sortedset, "VALUES_VIA", "gather")
+    _, new_a, ovf_a = deltaset.insert(small_a, hi, lo, vh, vl, act)
+    monkeypatch.setattr(sortedset, "VALUES_VIA", "sort")
+    _, new_b, ovf_b = deltaset.insert(small_b, hi, lo, vh, vl, act)
+    assert bool(ovf_a) and bool(ovf_b)
+    assert np.array_equal(np.asarray(new_a), np.asarray(new_b))
