@@ -340,9 +340,10 @@ class XlaChecker(Checker):
         # dispatch does not cost consumers (bench_detail.json) the
         # per-level breakdown.
         self.level_log: List[Dict[str, int]] = []
-        # Fused-dispatch telemetry: (run_cap, committed_levels) per device
-        # call — makes the bucket ladder's choices (jump rungs, tail
-        # shrink-exits) observable to tests and the superstep profiler.
+        # Dispatch telemetry, both paths: (run_cap, committed_levels) per
+        # device call — makes the bucket ladder's choices (jump rungs,
+        # tail shrink-exits, lpd=1 snug picks) observable to tests and
+        # the superstep profiler.
         self.dispatch_log: List[Tuple[int, int]] = []
         # Host-verified-path telemetry (the sampled-predicate cliff,
         # VERDICT r4 weak #6): how much the conservative device predicate
@@ -1752,6 +1753,8 @@ class XlaChecker(Checker):
                 hv_fps,
                 hv_counts,
             ) = out
+            committed = not (bool(t_ovf) or bool(f_ovf) or bool(cc_ovf))
+            self.dispatch_log.append((run_cap, int(committed)))
             if bool(c_ovf):
                 self._raise_codec_overflow()
             if bool(t_ovf):
