@@ -1,0 +1,105 @@
+"""Standalone bisector for the delta-structure TPU runtime fault.
+
+Round-5 history: the pre-redesign delta insert (flush as a ``lax.cond``
+branch carrying a main-capacity sort) reproducibly crashed the TPU
+runtime ("TPU worker crashed — kernel fault") at 2^22 AND 2^27 main
+tiers while staying exact on CPU. The redesign (host-invoked
+``maintain``) removes that shape; the soak retries it at rm=8/rm=10.
+If the retry faults again, THIS tool pins where: it runs each delta
+program (insert at empty delta, insert at near-full delta, maintain)
+standalone across a ladder of main-tier shapes, checking results
+against numpy on the way, so the first faulting (program, shape) pair
+is the last line printed.
+
+Each shape runs in-process (a fault kills the process — run under
+``timeout`` and read the log tail). Usage:
+    python tools/delta_diag.py [--cpu] [max_log2_C]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    if "--cpu" in sys.argv:
+        sys.argv.remove("--cpu")
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            ),
+        )
+    import jax.numpy as jnp
+
+    from stateright_tpu.ops import deltaset
+
+    max_pow = int(sys.argv[1]) if len(sys.argv) > 1 else 27
+    print(f"backend={jax.default_backend()} shapes up to 2^{max_pow}", flush=True)
+
+    rng = np.random.default_rng(3)
+
+    ins = jax.jit(deltaset.insert)
+
+    for pow_c in range(18, max_pow + 1, 3):
+        C = 1 << pow_c
+        t0 = time.monotonic()
+        ds = deltaset.make(C, jnp)
+        # Batch sized to half the delta tier (C/16-row tier): big enough
+        # to be a realistic level, small enough that the empty-delta
+        # insert cannot overflow.
+        m = ds.delta_capacity // 2
+        hi = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+        lo = jnp.asarray(rng.integers(1, 2**32, m, dtype=np.uint32))
+        vh = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+        act = jnp.ones((m,), bool)
+
+        print(f"[delta_diag] C=2^{pow_c} insert(empty-delta) ...", flush=True)
+        ds1, is_new, ovf = ins(ds, hi, lo, vh, vh, act)
+        n_new = int(np.asarray(is_new).sum())
+        assert not bool(ovf) and n_new > 0, (n_new, bool(ovf))
+        print(
+            f"[delta_diag] C=2^{pow_c} insert ok: {n_new} new "
+            f"({time.monotonic() - t0:.1f}s)",
+            flush=True,
+        )
+
+        print(f"[delta_diag] C=2^{pow_c} maintain(flush) ...", flush=True)
+        t0 = time.monotonic()
+        ds2, f_ovf = deltaset.maintain_jit(ds1)
+        assert not bool(f_ovf)
+        n_main = int(ds2.n_main)
+        assert n_main == n_new, (n_main, n_new)
+        print(
+            f"[delta_diag] C=2^{pow_c} maintain ok: {n_main} main rows "
+            f"({time.monotonic() - t0:.1f}s)",
+            flush=True,
+        )
+
+        print(f"[delta_diag] C=2^{pow_c} insert(post-flush, dup batch) ...", flush=True)
+        t0 = time.monotonic()
+        # Re-inserting the same batch must find every key in main.
+        _, is_new2, ovf2 = ins(ds2, hi, lo, vh, vh, act)
+        assert not bool(ovf2) and int(np.asarray(is_new2).sum()) == 0
+        print(
+            f"[delta_diag] C=2^{pow_c} dedup-vs-main ok "
+            f"({time.monotonic() - t0:.1f}s)",
+            flush=True,
+        )
+
+    print("[delta_diag] ALL SHAPES CLEAN", flush=True)
+
+
+if __name__ == "__main__":
+    main()
