@@ -193,3 +193,37 @@ def test_delta_insert_values_via_sort_matches_gather(monkeypatch):
     _, new_b, ovf_b = deltaset.insert(small_b, hi, lo, vh, vl, act)
     assert bool(ovf_a) and bool(ovf_b)
     assert np.array_equal(np.asarray(new_a), np.asarray(new_b))
+
+
+def test_engine_delta_flushes_during_tail_shrink(monkeypatch):
+    """rm=5 with a 256-row delta tier forces many host-invoked flushes
+    while the fused loop's tail shrink-exit is downshifting buckets —
+    the two dispatch-boundary mechanisms must compose without losing
+    exactness. Pins both: exact counts AND an observed downshift."""
+    from test_ladder import assert_tail_downshift
+
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    # 2^14 >> 6 = 256: both knobs patched so the tier STARTS at 256 rows
+    # (MIN_DELTA alone would be outrun by the default shift's 1024). A
+    # 256-row tier cannot hold rm=5's peak-level winners (~2.3k), so the
+    # run must also exercise the empty-delta-overflow growth cascade
+    # until the tier fits a level.
+    monkeypatch.setattr(deltaset, "DELTA_SHIFT", 6)
+    monkeypatch.setattr(deltaset, "MIN_DELTA", 256)
+    c = (
+        PackedTwoPhaseSys(5)
+        .checker()
+        .spawn_xla(dedup="delta", frontier_capacity=1 << 13, table_capacity=1 << 14)
+        .join()
+    )
+    assert (c.state_count(), c.unique_state_count(), c.max_depth()) == (
+        58_146,
+        8_832,
+        17,
+    )
+    # Keys reach main only through a flush: flushes fired.
+    assert int(c._table.n_main) > 0
+    # And the tier was grown past its starting 256 rows by the cascade.
+    assert c._table.delta_capacity > 256
+    assert_tail_downshift(c.dispatch_log)

@@ -69,6 +69,15 @@ def test_ladder_validation():
         PackedTwoPhaseSys(3).checker().spawn_xla(ladder="sideways", **KW)
 
 
+def assert_tail_downshift(dispatch_log):
+    """At least one dispatch after the peak bucket ran below it (the
+    shrink-exit fired). Shared by the delta-interplay test."""
+    caps = [cap for cap, _ in dispatch_log]
+    peak = max(caps)
+    after_peak = caps[caps.index(peak) + 1 :]
+    assert after_peak and min(after_peak) < peak, dispatch_log
+
+
 def test_tail_shrink_exit_redispatches_snug():
     """Once the frontier collapses past the peak, the fused loop must hand
     the tail levels back to smaller already-compiled buckets (the
@@ -96,13 +105,12 @@ def test_tail_shrink_exit_redispatches_snug():
             8_258,
             1_568,
         ), ladder
-        caps = [cap for cap, _ in checker.dispatch_log]
-        peak = max(caps)
-        after_peak = caps[caps.index(peak) + 1 :]
         # The 2pc tail collapses to single digits: at least one tail
         # dispatch must run below the peak bucket...
-        assert after_peak and min(after_peak) < peak, (ladder, checker.dispatch_log)
+        assert_tail_downshift(checker.dispatch_log)
         # ...with every post-peak dispatch a pure cache hit.
+        caps = [cap for cap, _ in checker.dispatch_log]
+        peak = max(caps)
         past_peak = False
         for f_cap, missed in miss_log:
             if f_cap == peak:
