@@ -1,0 +1,198 @@
+"""Pallas stream-compaction prototype: the grid compaction without a sort.
+
+The engine's largest per-level op is the grid-compaction sort —
+(W+1 operands) x (A*F lanes) of ``lax.sort`` — whose only job under the
+state-major ("bsearch") flatten is ORDER-PRESERVING stream compaction:
+move the ``mask``-selected lanes of ``[P, M]`` planes to the front of a
+``[P, cap]`` output. A sort is O(n log^2 n) data passes; a streaming
+kernel is O(n): TPU pallas grids execute blocks SEQUENTIALLY on a core,
+so a running output offset can live in SMEM scratch across grid steps,
+and each block writes its survivors with one dynamic-offset contiguous
+store — no scatters (the XLA:TPU scatter pathologies, see
+docs/backend_pathologies.md, never enter the picture).
+
+Block scheme (block size B, grid step b):
+  1. load mask block [B], planes block [P, B] (VMEM),
+  2. local ranks: exclusive cumsum of the mask,
+  3. in-VMEM compaction of the block: each output slot j pulls the
+     lane holding the (j+1)-th set bit (iota-compare one-hot matmul —
+     MXU-friendly — or a VMEM gather; both are block-local),
+  4. store [P, B] at out[:, pl.ds(offset, B)] — the first n_b lanes are
+     real, the garbage tail is OVERWRITTEN by the next block because
+     offset advances by n_b (sequential grid = no race),
+  5. offset += n_b (SMEM carry).
+Lanes past the total survivor count are garbage the caller masks (the
+engine already masks by ``n_valid``, same as the sort lowerings).
+
+Correctness is validated in interpret mode on CPU (this file's main());
+whether it beats the sort on chip is for tools/ to A/B — if it does,
+it becomes a fourth ``compaction=`` lowering.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def compact_pallas(mask, planes, cap: int, *, block: int = 1024, interpret: bool = False):
+    """Order-preserving stream compaction of ``planes`` [P, M] by ``mask``
+    [M] into [P, cap]. Lanes at index >= sum(mask) are UNSPECIFIED (the
+    caller masks by its own valid count). M and cap must be multiples of
+    ``block``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, M = planes.shape
+    assert mask.shape == (M,)
+    assert M % block == 0 and cap % block == 0, (M, cap, block)
+    # The [P, cap] output stays VMEM-resident across every grid step
+    # (index map (0, 0)) — fine up to a few MB. The engine-scale cap
+    # (2^22 lanes) needs the HBM-staged variant (aligned chunk DMAs from
+    # a VMEM ring) before integration; this version is the concept's
+    # correctness + perf-model probe.
+
+    def kernel(mask_ref, planes_ref, out_ref, off_ref):
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _init():
+            off_ref[0] = 0
+
+        m = mask_ref[:].astype(jnp.int32)  # [B]
+        incl = jnp.cumsum(m)  # inclusive ranks, 1-based at set lanes
+        n_b = incl[block - 1]
+        # Output slot j takes the lane with the (j+1)-th set bit: build
+        # the [B, B] selector one-hot (sel[j, i] = 1 iff lane i is the
+        # (j+1)-th survivor) and contract it with the planes block. The
+        # one-hot contraction is exact in f32 (planes split into u16
+        # halves, 16-bit payloads are exact f32) and lands on the MXU.
+        j = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        i_rank = jnp.where(m > 0, incl - 1, -1)  # [B], -1 for dead lanes
+        sel = (j == i_rank[None, :]).astype(jnp.float32)  # [B, B]
+        blk = planes_ref[:, :]  # [P, B] uint32
+        lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.float32)
+        hi16 = (blk >> jnp.uint32(16)).astype(jnp.float32)
+        # [B,B] x [B, 2P] -> [B, 2P]
+        gathered = jax.lax.dot_general(
+            sel,
+            jnp.concatenate([lo16, hi16], axis=0).T,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        glo = gathered[:, :P].T.astype(jnp.uint32)  # [P, B]
+        ghi = gathered[:, P:].T.astype(jnp.uint32)
+        compacted = glo | (ghi << jnp.uint32(16))
+        off = off_ref[0]
+
+        @pl.when(off + block <= cap)
+        def _store():
+            out_ref[:, pl.ds(off, block)] = compacted
+
+        off_ref[0] = off + n_b
+
+    grid = (M // block,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block,), lambda b: (b,)),
+            pl.BlockSpec((P, block), lambda b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec((P, cap), lambda b: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((P, cap), planes.dtype),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(mask, planes)
+
+
+def _sort_compact(mask, planes, cap: int):
+    """The engine's sort-lowering equivalent at the same shapes: stable
+    single-key sort carrying every plane (compact_1d's "sort" mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jnp.where(mask, jnp.int32(0), jnp.int32(1))
+    out = jax.lax.sort((key, *[planes[p] for p in range(planes.shape[0])]),
+                       num_keys=1, is_stable=True)
+    return jnp.stack([o[:cap] for o in out[1:]])
+
+
+def main() -> None:
+    import itertools
+    import time
+
+    import jax
+
+    if "--cpu" in sys.argv:
+        sys.argv.remove("--cpu")
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            ),
+        )
+    import jax.numpy as jnp
+
+    interpret = jax.default_backend() == "cpu"
+    rng = np.random.default_rng(9)
+
+    # --- correctness ----------------------------------------------------
+    P, M, cap, B = 8, 1 << 14, 1 << 13, 512
+    mask_np = rng.integers(0, 5, M) == 0  # ~20% density, under cap
+    planes_np = rng.integers(0, 2**32, (P, M), dtype=np.uint32)
+    out = compact_pallas(
+        jnp.asarray(mask_np), jnp.asarray(planes_np), cap, block=B,
+        interpret=interpret,
+    )
+    n = int(mask_np.sum())
+    want = planes_np[:, mask_np]
+    got = np.asarray(out)[:, :n]
+    assert np.array_equal(got, want), "MISMATCH"
+    print(f"pallas compact OK: {n} survivors of {M}, P={P}, interpret={interpret}")
+    if interpret:
+        return  # interpreter timings are meaningless
+
+    # --- perf A/B vs the sort lowering (host-readback-gated) ------------
+    for log2_m, B in itertools.product((20, 22), (512, 1024)):
+        M = 1 << log2_m
+        cap = M // 4  # VMEM-resident output probe shape
+        mask_np = rng.integers(0, 8, M) == 0  # ~12% (rm=8 grid validity)
+        planes_np = rng.integers(0, 2**32, (P, M), dtype=np.uint32)
+        mask = jnp.asarray(mask_np)
+        planes = jnp.asarray(planes_np)
+
+        f_pal = jax.jit(functools.partial(compact_pallas, cap=cap, block=B))
+        f_sort = jax.jit(functools.partial(_sort_compact, cap=cap))
+        for name, fn in (("pallas", f_pal), ("sort", f_sort)):
+            try:
+                o = fn(mask, planes)
+            except Exception as e:  # lowering failures are a result too
+                print(f"  M=2^{log2_m} B={B} {name}: FAILED {type(e).__name__}: {e}")
+                continue
+            nvl = int(np.asarray(mask).sum())
+            ok = np.array_equal(np.asarray(o)[:, :nvl], planes_np[:, mask_np])
+            t0 = time.monotonic()
+            for _ in range(5):
+                o = fn(mask, planes)
+            np.asarray(o[0][:8])  # readback gates the clock
+            dt = (time.monotonic() - t0) / 5
+            print(
+                f"  M=2^{log2_m} B={B} {name}: {dt * 1e3:8.2f} ms "
+                f"({'exact' if ok else 'WRONG'})",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,10 +30,14 @@ while true; do
     commit_stage "TPU r5c: bench with the shrink-exit engine (rc=$rc1)" \
       bench_r5d_out.json bench_detail.json bench_probe.log
 
-    log "stage 2: sort-dtype A/B (key packing) + superstep profile"
+    log "stage 2: sort-dtype A/B (key packing) + pallas compaction A/B + superstep profile"
     timeout 1200 python tools/sortbench.py 23 >tpu_sortbench.log 2>&1
     rc2a=$?
     log "sortbench rc=$rc2a: $(tail -c 200 tpu_sortbench.log 2>/dev/null)"
+    timeout 1200 python tools/pallas_compact.py >tpu_pallas_compact.log 2>&1
+    rc2p=$?
+    log "pallas_compact rc=$rc2p: $(tail -c 200 tpu_pallas_compact.log 2>/dev/null)"
+    git add -f tpu_pallas_compact.log >>"$LOG" 2>&1
     timeout 2700 python tools/profile_superstep.py 8 >tpu_profile_r5c.log 2>&1
     rc2=$?
     log "profile rc=$rc2"
