@@ -113,6 +113,101 @@ def compact_pallas(mask, planes, cap: int, *, block: int = 1024, interpret: bool
     )(mask, planes)
 
 
+def compact_pallas_staged(
+    mask, planes, cap: int, *, block: int = 1024, interpret: bool = False
+):
+    """The engine-scale variant: output lives in HBM; survivors stream
+    through a [P, 2B] VMEM ring and flush to the output in B-aligned
+    chunk DMAs (the only HBM writes — contiguous, aligned, no scatters).
+    SMEM carries (total appended, flushed chunks) across the sequential
+    grid. Unspecified lanes at and past the survivor count, like
+    :func:`compact_pallas`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, M = planes.shape
+    assert mask.shape == (M,)
+    assert M % block == 0 and cap % block == 0, (M, cap, block)
+    B = block
+    n_blocks = M // B
+
+    def kernel(mask_ref, planes_ref, out_ref, stage, cnt, sem):
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _init():
+            cnt[0] = 0  # survivors appended
+            cnt[1] = 0  # chunks flushed
+
+        m = mask_ref[:].astype(jnp.int32)
+        incl = jnp.cumsum(m)
+        n_b = incl[B - 1]
+        j = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+        i_rank = jnp.where(m > 0, incl - 1, -1)
+        sel = (j == i_rank[None, :]).astype(jnp.float32)
+        blk = planes_ref[:, :]
+        lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.float32)
+        hi16 = (blk >> jnp.uint32(16)).astype(jnp.float32)
+        gathered = jax.lax.dot_general(
+            sel,
+            jnp.concatenate([lo16, hi16], axis=0).T,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        compacted = gathered[:, :P].T.astype(jnp.uint32) | (
+            gathered[:, P:].T.astype(jnp.uint32) << jnp.uint32(16)
+        )
+        t, c = cnt[0], cnt[1]
+        p = t - c * B  # append position within the ring, in [0, B)
+        stage[:, pl.ds(p, B)] = compacted
+        t = t + n_b
+        cnt[0] = t
+
+        def flush(chunk_idx):
+            dma = pltpu.make_async_copy(
+                stage.at[:, pl.ds(0, B)],
+                out_ref.at[:, pl.ds(chunk_idx * B, B)],
+                sem,
+            )
+            dma.start()
+            dma.wait()
+
+        @pl.when((t - c * B >= B) & ((c + 1) * B <= cap))
+        def _flush_full():
+            flush(c)
+            # Slide the ring: the second half becomes the first.
+            stage[:, pl.ds(0, B)] = stage[:, pl.ds(B, B)]
+            cnt[1] = c + 1
+
+        @pl.when(b == n_blocks - 1)
+        def _flush_tail():
+            c2 = cnt[1]
+
+            @pl.when((cnt[0] > c2 * B) & ((c2 + 1) * B <= cap))
+            def _():
+                flush(c2)
+
+    grid = (n_blocks,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B,), lambda b: (b,)),
+            pl.BlockSpec((P, B), lambda b: (0, b)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((P, cap), planes.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((P, 2 * B), planes.dtype),
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(mask, planes)
+
+
 def _sort_compact(mask, planes, cap: int):
     """The engine's sort-lowering equivalent at the same shapes: stable
     single-key sort carrying every plane (compact_1d's "sort" mode)."""
@@ -160,6 +255,14 @@ def main() -> None:
     got = np.asarray(out)[:, :n]
     assert np.array_equal(got, want), "MISMATCH"
     print(f"pallas compact OK: {n} survivors of {M}, P={P}, interpret={interpret}")
+
+    out_s = compact_pallas_staged(
+        jnp.asarray(mask_np), jnp.asarray(planes_np), cap, block=B,
+        interpret=interpret,
+    )
+    got_s = np.asarray(out_s)[:, :n]
+    assert np.array_equal(got_s, want), "STAGED MISMATCH"
+    print(f"pallas staged compact OK: {n} survivors, HBM out + VMEM ring")
     if interpret:
         return  # interpreter timings are meaningless
 
@@ -173,8 +276,9 @@ def main() -> None:
         planes = jnp.asarray(planes_np)
 
         f_pal = jax.jit(functools.partial(compact_pallas, cap=cap, block=B))
+        f_stg = jax.jit(functools.partial(compact_pallas_staged, cap=cap, block=B))
         f_sort = jax.jit(functools.partial(_sort_compact, cap=cap))
-        for name, fn in (("pallas", f_pal), ("sort", f_sort)):
+        for name, fn in (("pallas", f_pal), ("staged", f_stg), ("sort", f_sort)):
             try:
                 o = fn(mask, planes)
             except Exception as e:  # lowering failures are a result too
@@ -192,6 +296,34 @@ def main() -> None:
                 f"({'exact' if ok else 'WRONG'})",
                 flush=True,
             )
+
+    # --- the engine shape: M=2^24 grid lanes, cap=2^22 (out in HBM) -----
+    log2_m, B = 24, 1024
+    M, cap = 1 << log2_m, 1 << 22
+    mask_np = rng.integers(0, 8, M) == 0
+    planes_np = rng.integers(0, 2**32, (P, M), dtype=np.uint32)
+    mask = jnp.asarray(mask_np)
+    planes = jnp.asarray(planes_np)
+    f_stg = jax.jit(functools.partial(compact_pallas_staged, cap=cap, block=B))
+    f_sort = jax.jit(functools.partial(_sort_compact, cap=cap))
+    for name, fn in (("staged", f_stg), ("sort", f_sort)):
+        try:
+            o = fn(mask, planes)
+        except Exception as e:
+            print(f"  M=2^{log2_m} B={B} {name}: FAILED {type(e).__name__}: {e}")
+            continue
+        nvl = int(mask_np.sum())
+        ok = np.array_equal(np.asarray(o)[:, :nvl], planes_np[:, mask_np])
+        t0 = time.monotonic()
+        for _ in range(5):
+            o = fn(mask, planes)
+        np.asarray(o[0][:8])
+        dt = (time.monotonic() - t0) / 5
+        print(
+            f"  M=2^{log2_m} B={B} {name} (engine shape): {dt * 1e3:8.2f} ms "
+            f"({'exact' if ok else 'WRONG'})",
+            flush=True,
+        )
 
 
 if __name__ == "__main__":
