@@ -1,0 +1,215 @@
+"""Pallas stream-compaction kernels: order-preserving compaction in O(n).
+
+The engine's largest per-level op is the grid-compaction sort —
+(W+1 operands) x (A*F lanes) of ``lax.sort``. Under the state-major
+flatten (the "bsearch" layout) its only job is ORDER-PRESERVING stream
+compaction of P uint32 lane arrays by a mask into a ``[P, cap]`` output.
+A sort is O(n log^2 n) data passes; these kernels are O(n): TPU pallas
+grids execute blocks SEQUENTIALLY on a core, so a running output offset
+lives in SMEM scratch across grid steps and every HBM write is a
+contiguous, B-aligned chunk DMA — no scatters
+(docs/backend_pathologies.md #2/#5 never enter the picture).
+
+Per block b of B lanes:
+  1. local ranks: inclusive cumsum of the mask block,
+  2. in-VMEM block compaction: output slot j pulls the lane holding the
+     (j+1)-th set bit via a one-hot [B, B] contraction at
+     ``Precision.HIGHEST`` — each output sums exactly ONE nonzero
+     product of 16-bit-valued f32s, so the result is exact; the default
+     bf16 MXU pass would silently truncate the u16 halves (8-bit
+     mantissa), which is why the precision pin is load-bearing,
+  3. survivors append into a [P, 2B] VMEM ring at the running offset;
+     full B-aligned chunks DMA to the HBM output,
+  4. the garbage tail of each chunk is overwritten by the next flush
+     (sequential grid = no race); lanes at and past the total survivor
+     count are UNSPECIFIED — callers re-mask (the engine's zero-pad
+     contract is applied outside the kernel).
+
+Inputs are SEPARATE 1-D lane refs (not one stacked [P, M] array): the
+engine's lanes already exist as independent buffers, and a pre-kernel
+``jnp.stack`` would cost a full extra read+write of the grid — against
+the kernel's whole point.
+
+``compact_pallas`` keeps the output VMEM-resident (probe/testing shape);
+``compact_pallas_staged`` is the engine-scale variant. Equality against
+the sort lowering is pinned by ``tests/test_pallas_compact.py`` and the
+engine differential; whether it is FASTER on chip is the
+``tools/pallas_compact.py`` A/B's question.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def _as_lanes(planes):
+    """Accept either a [P, M] array (tools/tests convenience) or a
+    sequence of [M] lanes (the engine's zero-copy form)."""
+    if hasattr(planes, "ndim"):
+        assert planes.ndim == 2
+        return [planes[p] for p in range(planes.shape[0])]
+    return list(planes)
+
+
+def _block_compact(mask_ref, plane_refs, B: int):
+    """Shared block body: local compaction of P lane blocks [B] by a [B]
+    mask block via the one-hot contraction. Returns ``(compacted [P, B],
+    n_b)`` — survivors dense at the front, tail unspecified."""
+    import jax
+    import jax.numpy as jnp
+
+    P = len(plane_refs)
+    m = mask_ref[:].astype(jnp.int32)
+    incl = jnp.cumsum(m)
+    n_b = incl[B - 1]
+    j = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+    i_rank = jnp.where(m > 0, incl - 1, -1)
+    sel = (j == i_rank[None, :]).astype(jnp.float32)
+    blk = jnp.stack([r[:] for r in plane_refs])  # [P, B], VMEM-local
+    lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.float32)
+    hi16 = (blk >> jnp.uint32(16)).astype(jnp.float32)
+    gathered = jax.lax.dot_general(
+        sel,
+        jnp.concatenate([lo16, hi16], axis=0).T,
+        (((1,), (0,)), ((), ())),
+        # Exactness pin — see the module docstring. DEFAULT would run a
+        # single bf16 pass and truncate the 16-bit payload halves.
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    compacted = gathered[:, :P].T.astype(jnp.uint32) | (
+        gathered[:, P:].T.astype(jnp.uint32) << jnp.uint32(16)
+    )
+    return compacted, n_b
+
+
+def compact_pallas(
+    mask, planes, cap: int, *, block: int = 1024, interpret: bool = False
+):
+    """Order-preserving stream compaction of P uint32 lanes [M] by
+    ``mask`` [M] into [P, cap], output VMEM-resident (small caps only).
+    Lanes at index >= sum(mask) are UNSPECIFIED. M and cap must be
+    multiples of ``block``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    lanes = _as_lanes(planes)
+    P = len(lanes)
+    M = lanes[0].shape[0]
+    assert mask.shape == (M,)
+    assert M % block == 0 and cap % block == 0, (M, cap, block)
+
+    def kernel(mask_ref, *rest):
+        plane_refs, out_ref, off_ref = rest[:P], rest[P], rest[P + 1]
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _init():
+            off_ref[0] = 0
+
+        compacted, n_b = _block_compact(mask_ref, plane_refs, block)
+        off = off_ref[0]
+
+        @pl.when(off + block <= cap)
+        def _store():
+            out_ref[:, pl.ds(off, block)] = compacted
+
+        off_ref[0] = off + n_b
+
+    lane_spec = pl.BlockSpec((block,), lambda b: (b,))
+    return pl.pallas_call(
+        kernel,
+        grid=(M // block,),
+        in_specs=[lane_spec] * (1 + P),
+        out_specs=pl.BlockSpec((P, cap), lambda b: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((P, cap), lanes[0].dtype),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(mask, *lanes)
+
+
+def compact_pallas_staged(
+    mask, planes, cap: int, *, block: int = 1024, interpret: bool = False
+):
+    """The engine-scale variant: output lives in HBM; survivors stream
+    through a [P, 2B] VMEM ring and flush to the output in B-aligned
+    chunk DMAs. SMEM carries (total appended, flushed chunks) across the
+    sequential grid. Unspecified lanes as in :func:`compact_pallas`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    lanes = _as_lanes(planes)
+    P = len(lanes)
+    M = lanes[0].shape[0]
+    assert mask.shape == (M,)
+    assert M % block == 0 and cap % block == 0, (M, cap, block)
+    B = block
+    n_blocks = M // B
+
+    def kernel(mask_ref, *rest):
+        plane_refs = rest[:P]
+        out_ref, stage, cnt, sem = rest[P], rest[P + 1], rest[P + 2], rest[P + 3]
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _init():
+            cnt[0] = 0  # survivors appended
+            cnt[1] = 0  # chunks flushed
+
+        compacted, n_b = _block_compact(mask_ref, plane_refs, B)
+        t, c = cnt[0], cnt[1]
+        p = t - c * B  # append position within the ring, in [0, B)
+
+        # Once flushing is frozen at the cap (survivor overflow — the
+        # engine discards and retries the level), t keeps growing while
+        # c does not; appending would then address past the 2B ring.
+        # Mosaic documents OOB access as undefined behavior, so skip.
+        @pl.when(p + B <= 2 * B)
+        def _append():
+            stage[:, pl.ds(p, B)] = compacted
+
+        t = t + n_b
+        cnt[0] = t
+
+        def flush(chunk_idx):
+            dma = pltpu.make_async_copy(
+                stage.at[:, pl.ds(0, B)],
+                out_ref.at[:, pl.ds(chunk_idx * B, B)],
+                sem,
+            )
+            dma.start()
+            dma.wait()
+
+        @pl.when((t - c * B >= B) & ((c + 1) * B <= cap))
+        def _flush_full():
+            flush(c)
+            # Slide the ring: the second half becomes the first.
+            stage[:, pl.ds(0, B)] = stage[:, pl.ds(B, B)]
+            cnt[1] = c + 1
+
+        @pl.when(b == n_blocks - 1)
+        def _flush_tail():
+            c2 = cnt[1]
+
+            @pl.when((cnt[0] > c2 * B) & ((c2 + 1) * B <= cap))
+            def _():
+                flush(c2)
+
+    lane_spec = pl.BlockSpec((B,), lambda b: (b,))
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[lane_spec] * (1 + P),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((P, cap), lanes[0].dtype),
+        scratch_shapes=[
+            pltpu.VMEM((P, 2 * B), lanes[0].dtype),
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(mask, *lanes)

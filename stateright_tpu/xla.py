@@ -216,10 +216,22 @@ class XlaChecker(Checker):
                 if jax.default_backend() == "cpu" or model.state_words > 8
                 else "sort"
             )
-        if compaction not in ("gather", "sort", "bsearch"):
+        # "pallas": the state-major layout of "bsearch" with the
+        # compaction itself as a sequential-grid pallas streaming kernel
+        # (ops/pallas_compact.py) — O(n) data movement instead of the
+        # sort's O(n log^2 n). Opt-in until chip-proven; small shapes
+        # (bucket below the kernel block) fall back to the stable sort
+        # inside compact_1d, bit-identically.
+        if compaction not in ("gather", "sort", "bsearch", "pallas"):
             raise ValueError(
-                "compaction must be 'auto', 'gather', 'sort', or "
-                f"'bsearch': {compaction!r}"
+                "compaction must be 'auto', 'gather', 'sort', "
+                f"'bsearch', or 'pallas': {compaction!r}"
+            )
+        if compaction == "pallas" and not self._soa:
+            raise ValueError(
+                "compaction='pallas' runs in the plane-major engine: "
+                "pass dedup='sorted' or 'delta' (the hash engine is the "
+                "rows path)"
             )
         self._compaction = compaction
         # Bucket-ladder policy. "ramp" steps one power-of-four rung per
@@ -797,6 +809,13 @@ class XlaChecker(Checker):
 
         compaction = self._compaction
         sort_compact = compaction == "sort"
+        # Pallas-lowering knobs, resolved at build time: the kernel block
+        # (grid sequential-step granularity; smaller engages the kernel
+        # at smaller shapes — tests use this) and interpret mode (the
+        # kernel has no CPU lowering; the interpreter is the CPU
+        # reference semantics).
+        pallas_block = int(os.environ.get("STPU_PALLAS_BLOCK", "1024"))
+        pallas_interp = jax.default_backend() == "cpu"
 
         def compact_1d(mask, cap, arrays, prio=None, rows_out=()):
             """Stream-compact lanes where ``mask`` holds into ``cap`` slots.
@@ -842,7 +861,30 @@ class XlaChecker(Checker):
                         ("rows" if pos in rows_out else "planes", a.shape[0])
                     )
 
-            if compaction == "bsearch" and prio is None:
+            pallas_ok = (
+                compaction == "pallas"
+                and prio is None
+                and m % pallas_block == 0
+                and cap % pallas_block == 0
+                and m >= pallas_block
+                and cap >= pallas_block
+                and all(lane.dtype == jnp.uint32 for lane in lanes)
+            )
+            if pallas_ok:
+                # Sequential-grid streaming kernel: O(n) data movement,
+                # aligned chunk DMAs, no scatters (ops/pallas_compact.py).
+                # Lanes pass as separate refs — no stacked copy of the
+                # grid. Shapes below the kernel block fall to the sort
+                # branch.
+                from .ops.pallas_compact import compact_pallas_staged
+
+                kout = compact_pallas_staged(
+                    mask, lanes, cap, block=pallas_block,
+                    interpret=pallas_interp,
+                )
+                smask = jnp.arange(take) < n_valid
+                slanes = [kout[i][:take] for i in range(len(lanes))]
+            elif compaction == "bsearch" and prio is None:
                 # Rank i's source lane = first j with cumsum(mask)[j] == i+1:
                 # one scan + log2(m) gather rounds + one ascending gather per
                 # lane. No sort, no scatter.
@@ -853,10 +895,11 @@ class XlaChecker(Checker):
                 pos_idx = jnp.minimum(pos_idx, m - 1)
                 smask = jnp.arange(take) < n_valid
                 slanes = [lane[pos_idx] for lane in lanes]
-            elif sort_compact or compaction == "bsearch":
+            elif sort_compact or compaction in ("bsearch", "pallas"):
                 # ("bsearch" with a prio falls back to the sort lowering —
                 # the engine's bsearch grid build emits state-major order,
-                # so no prio path stays hot under it.)
+                # so no prio path stays hot under it; "pallas" lands here
+                # for shapes below its kernel block.)
                 sorted_all = jax.lax.sort(
                     (key, *lanes), num_keys=1, is_stable=True
                 )
@@ -955,11 +998,11 @@ class XlaChecker(Checker):
             #    the flatten is a-major (F stays on the 128-lane axis — the
             #    tiling-friendly transpose) and a prio key restores the
             #    semantic order inside the compaction sort. Under "bsearch"
-            #    the flatten is state-major (k = f*A + a) so array order IS
-            #    semantic order and the compaction needs no sort at all;
-            #    the [.., F, A] intermediate's minor-axis padding is fused
-            #    away into the reshape consumer.
-            if compaction == "bsearch":
+            #    and "pallas" the flatten is state-major (k = f*A + a) so
+            #    array order IS semantic order and the compaction needs no
+            #    sort at all; the [.., F, A] intermediate's minor-axis
+            #    padding is fused away into the reshape consumer.
+            if compaction in ("bsearch", "pallas"):
                 if self._expand_layout == "planes":
                     grid = jnp.transpose(nxt, (1, 2, 0)).reshape(W, f_cap * A)
                 else:

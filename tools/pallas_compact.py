@@ -40,172 +40,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def compact_pallas(mask, planes, cap: int, *, block: int = 1024, interpret: bool = False):
-    """Order-preserving stream compaction of ``planes`` [P, M] by ``mask``
-    [M] into [P, cap]. Lanes at index >= sum(mask) are UNSPECIFIED (the
-    caller masks by its own valid count). M and cap must be multiples of
-    ``block``."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    P, M = planes.shape
-    assert mask.shape == (M,)
-    assert M % block == 0 and cap % block == 0, (M, cap, block)
-    # The [P, cap] output stays VMEM-resident across every grid step
-    # (index map (0, 0)) — fine up to a few MB. The engine-scale cap
-    # (2^22 lanes) needs the HBM-staged variant (aligned chunk DMAs from
-    # a VMEM ring) before integration; this version is the concept's
-    # correctness + perf-model probe.
-
-    def kernel(mask_ref, planes_ref, out_ref, off_ref):
-        b = pl.program_id(0)
-
-        @pl.when(b == 0)
-        def _init():
-            off_ref[0] = 0
-
-        m = mask_ref[:].astype(jnp.int32)  # [B]
-        incl = jnp.cumsum(m)  # inclusive ranks, 1-based at set lanes
-        n_b = incl[block - 1]
-        # Output slot j takes the lane with the (j+1)-th set bit: build
-        # the [B, B] selector one-hot (sel[j, i] = 1 iff lane i is the
-        # (j+1)-th survivor) and contract it with the planes block. The
-        # one-hot contraction is exact in f32 (planes split into u16
-        # halves, 16-bit payloads are exact f32) and lands on the MXU.
-        j = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-        i_rank = jnp.where(m > 0, incl - 1, -1)  # [B], -1 for dead lanes
-        sel = (j == i_rank[None, :]).astype(jnp.float32)  # [B, B]
-        blk = planes_ref[:, :]  # [P, B] uint32
-        lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.float32)
-        hi16 = (blk >> jnp.uint32(16)).astype(jnp.float32)
-        # [B,B] x [B, 2P] -> [B, 2P]
-        gathered = jax.lax.dot_general(
-            sel,
-            jnp.concatenate([lo16, hi16], axis=0).T,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        glo = gathered[:, :P].T.astype(jnp.uint32)  # [P, B]
-        ghi = gathered[:, P:].T.astype(jnp.uint32)
-        compacted = glo | (ghi << jnp.uint32(16))
-        off = off_ref[0]
-
-        @pl.when(off + block <= cap)
-        def _store():
-            out_ref[:, pl.ds(off, block)] = compacted
-
-        off_ref[0] = off + n_b
-
-    grid = (M // block,)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((block,), lambda b: (b,)),
-            pl.BlockSpec((P, block), lambda b: (0, b)),
-        ],
-        out_specs=pl.BlockSpec((P, cap), lambda b: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((P, cap), planes.dtype),
-        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
-        interpret=interpret,
-    )(mask, planes)
-
-
-def compact_pallas_staged(
-    mask, planes, cap: int, *, block: int = 1024, interpret: bool = False
-):
-    """The engine-scale variant: output lives in HBM; survivors stream
-    through a [P, 2B] VMEM ring and flush to the output in B-aligned
-    chunk DMAs (the only HBM writes — contiguous, aligned, no scatters).
-    SMEM carries (total appended, flushed chunks) across the sequential
-    grid. Unspecified lanes at and past the survivor count, like
-    :func:`compact_pallas`."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    P, M = planes.shape
-    assert mask.shape == (M,)
-    assert M % block == 0 and cap % block == 0, (M, cap, block)
-    B = block
-    n_blocks = M // B
-
-    def kernel(mask_ref, planes_ref, out_ref, stage, cnt, sem):
-        b = pl.program_id(0)
-
-        @pl.when(b == 0)
-        def _init():
-            cnt[0] = 0  # survivors appended
-            cnt[1] = 0  # chunks flushed
-
-        m = mask_ref[:].astype(jnp.int32)
-        incl = jnp.cumsum(m)
-        n_b = incl[B - 1]
-        j = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
-        i_rank = jnp.where(m > 0, incl - 1, -1)
-        sel = (j == i_rank[None, :]).astype(jnp.float32)
-        blk = planes_ref[:, :]
-        lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.float32)
-        hi16 = (blk >> jnp.uint32(16)).astype(jnp.float32)
-        gathered = jax.lax.dot_general(
-            sel,
-            jnp.concatenate([lo16, hi16], axis=0).T,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        compacted = gathered[:, :P].T.astype(jnp.uint32) | (
-            gathered[:, P:].T.astype(jnp.uint32) << jnp.uint32(16)
-        )
-        t, c = cnt[0], cnt[1]
-        p = t - c * B  # append position within the ring, in [0, B)
-        stage[:, pl.ds(p, B)] = compacted
-        t = t + n_b
-        cnt[0] = t
-
-        def flush(chunk_idx):
-            dma = pltpu.make_async_copy(
-                stage.at[:, pl.ds(0, B)],
-                out_ref.at[:, pl.ds(chunk_idx * B, B)],
-                sem,
-            )
-            dma.start()
-            dma.wait()
-
-        @pl.when((t - c * B >= B) & ((c + 1) * B <= cap))
-        def _flush_full():
-            flush(c)
-            # Slide the ring: the second half becomes the first.
-            stage[:, pl.ds(0, B)] = stage[:, pl.ds(B, B)]
-            cnt[1] = c + 1
-
-        @pl.when(b == n_blocks - 1)
-        def _flush_tail():
-            c2 = cnt[1]
-
-            @pl.when((cnt[0] > c2 * B) & ((c2 + 1) * B <= cap))
-            def _():
-                flush(c2)
-
-    grid = (n_blocks,)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((B,), lambda b: (b,)),
-            pl.BlockSpec((P, B), lambda b: (0, b)),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        out_shape=jax.ShapeDtypeStruct((P, cap), planes.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((P, 2 * B), planes.dtype),
-            pltpu.SMEM((2,), jnp.int32),
-            pltpu.SemaphoreType.DMA,
-        ],
-        interpret=interpret,
-    )(mask, planes)
+from stateright_tpu.ops.pallas_compact import (  # noqa: E402
+    compact_pallas,
+    compact_pallas_staged,
+)
 
 
 def _sort_compact(mask, planes, cap: int):

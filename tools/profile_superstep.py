@@ -178,6 +178,9 @@ def main() -> None:
         # vs grid compaction) carries it, and whether a mix beats both.
         ("sorted", "sort", "gather"),
         ("sorted", "gather", "sort"),
+        # The pallas streaming compaction (O(n) vs the sort's n log^2 n):
+        # the full-engine measurement the synthetic probe can't give.
+        ("sorted", "sort", "pallas"),
         ("delta", "gather", "gather"),
         ("delta", "gather", "sort"),
         ("delta", "sort", "sort"),
