@@ -171,19 +171,22 @@ def main() -> None:
     # (insert-values + is_new routing via STPU_SORTEDSET_VALUES, planes
     # compaction via spawn_xla(compaction=); fresh model instances so the
     # in-process superstep cache cannot mix lowerings.)
+    # Decisive rows FIRST — tunnel windows can be short. The final four
+    # are the attack stack: current default, pallas compaction (O(n)
+    # stream vs n log^2 n sort), the redesigned delta tier, and the
+    # full stack delta+pallas (the projected ~9M gen/s configuration).
     for dedup, values_via, comp in (
-        ("sorted", "gather", "gather"),
         ("sorted", "sort", "sort"),
+        ("sorted", "sort", "pallas"),
+        ("delta", "sort", "sort"),
+        ("delta", "sort", "pallas"),
         # Mixed families: which half of the round-5 2.3x (insert payload
         # vs grid compaction) carries it, and whether a mix beats both.
         ("sorted", "sort", "gather"),
         ("sorted", "gather", "sort"),
-        # The pallas streaming compaction (O(n) vs the sort's n log^2 n):
-        # the full-engine measurement the synthetic probe can't give.
-        ("sorted", "sort", "pallas"),
-        ("delta", "gather", "gather"),
+        ("sorted", "gather", "gather"),
         ("delta", "gather", "sort"),
-        ("delta", "sort", "sort"),
+        ("delta", "gather", "gather"),
     ):
         sortedset.VALUES_VIA = values_via
         m3 = PackedTwoPhaseSys(rm)
