@@ -30,6 +30,14 @@ while true; do
     commit_stage "TPU r5c: bench with the shrink-exit engine (rc=$rc1)" \
       bench_r5d_out.json bench_detail.json bench_probe.log
 
+    log "stage 1b: attack-stack bench (delta dedup + pallas compaction)"
+    BENCH_DEDUP=delta STPU_COMPACTION=pallas BENCH_MATRIX=0 \
+      timeout 2400 python bench.py >bench_r5d_stack.json 2>>"$LOG"
+    rc1b=$?
+    log "stack bench rc=$rc1b: $(tail -c 300 bench_r5d_stack.json 2>/dev/null)"
+    commit_stage "TPU r5c: attack-stack bench delta+pallas (rc=$rc1b)" \
+      bench_r5d_stack.json
+
     log "stage 2: sort-dtype A/B (key packing) + pallas compaction A/B + superstep profile"
     timeout 1200 python tools/sortbench.py 23 >tpu_sortbench.log 2>&1
     rc2a=$?
