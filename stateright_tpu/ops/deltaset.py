@@ -185,13 +185,24 @@ def insert(
     # prologue sort or by two random [m]-lane gathers afterwards — the
     # same trade ``sortedset`` resolves per backend (the round-5 chip
     # A/B: random gathers at scale lose to payload-through-sort on TPU,
-    # win on 1-core CPU). Results are bit-identical.
-    from .sortedset import _via_sort
+    # win on 1-core CPU). Results are bit-identical. The u64 key-packing
+    # knob (STPU_SORTEDSET_KEYS=packed) is honored here too — silently
+    # falling back would record a pair-lowering soak as a packed
+    # measurement.
+    from .sortedset import _pack64, _unpack64, _via_packed, _via_sort
 
     kh = jnp.where(active, fp_hi, full)
     kl = jnp.where(active, fp_lo, full)
     ticket = jnp.arange(m, dtype=jnp.int32)
-    if _via_sort():
+    via_packed = _via_packed()
+    if via_packed:
+        k64 = _pack64(kh, kl, jnp)
+        sk64, st, sv64 = jax.lax.sort(
+            (k64, ticket, _pack64(val_hi, val_lo, jnp)), num_keys=2
+        )
+        skh, skl = _unpack64(sk64, jnp)
+        vh, vl = _unpack64(sv64, jnp)
+    elif _via_sort():
         skh, skl, st, vh, vl = jax.lax.sort(
             (kh, kl, ticket, val_hi, val_lo), num_keys=3
         )
@@ -230,17 +241,32 @@ def insert(
     overflow = new_total_delta > Dc
 
     # Merge winners into the delta tier: one sort of [Dc + m].
-    dkh = jnp.concatenate(
-        [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_hi, full),
-         jnp.where(winner, skh, full)]
-    )
-    dkl = jnp.concatenate(
-        [jnp.where(jnp.arange(Dc) < ds.n_delta, ds.delta_key_lo, full),
-         jnp.where(winner, skl, full)]
-    )
-    dvh = jnp.concatenate([ds.delta_val_hi, jnp.where(winner, vh, 0)])
-    dvl = jnp.concatenate([ds.delta_val_lo, jnp.where(winner, vl, 0)])
-    mkh, mkl, mvh, mvl = jax.lax.sort((dkh, dkl, dvh, dvl), num_keys=2)
+    dk_valid = jnp.arange(Dc) < ds.n_delta
+    if via_packed:
+        dk64 = jnp.concatenate(
+            [jnp.where(dk_valid, _pack64(ds.delta_key_hi, ds.delta_key_lo, jnp),
+                       jnp.uint64(0xFFFFFFFFFFFFFFFF)),
+             jnp.where(winner, _pack64(skh, skl, jnp), jnp.uint64(0xFFFFFFFFFFFFFFFF))]
+        )
+        dv64 = jnp.concatenate(
+            [_pack64(ds.delta_val_hi, ds.delta_val_lo, jnp),
+             jnp.where(winner, _pack64(vh, vl, jnp), jnp.uint64(0))]
+        )
+        mk64, mv64 = jax.lax.sort((dk64, dv64), num_keys=1)
+        mkh, mkl = _unpack64(mk64, jnp)
+        mvh, mvl = _unpack64(mv64, jnp)
+    else:
+        dkh = jnp.concatenate(
+            [jnp.where(dk_valid, ds.delta_key_hi, full),
+             jnp.where(winner, skh, full)]
+        )
+        dkl = jnp.concatenate(
+            [jnp.where(dk_valid, ds.delta_key_lo, full),
+             jnp.where(winner, skl, full)]
+        )
+        dvh = jnp.concatenate([ds.delta_val_hi, jnp.where(winner, vh, 0)])
+        dvl = jnp.concatenate([ds.delta_val_lo, jnp.where(winner, vl, 0)])
+        mkh, mkl, mvh, mvl = jax.lax.sort((dkh, dkl, dvh, dvl), num_keys=2)
     row_ok = jnp.arange(Dc) < jnp.minimum(new_total_delta, Dc)
     z = jnp.uint32(0)
     out = DeltaSet(
@@ -265,22 +291,40 @@ def maintain(ds: DeltaSet) -> Tuple[DeltaSet, "jax.Array"]:
     import jax
     import jax.numpy as jnp
 
+    from .sortedset import _pack64, _unpack64, _via_packed
+
     C = ds.main_capacity
     Dc = ds.delta_capacity
     full = jnp.uint32(0xFFFFFFFF)
     mk_valid = jnp.arange(C) < ds.n_main
     dk_valid = jnp.arange(Dc) < ds.n_delta
-    akh = jnp.concatenate(
-        [jnp.where(mk_valid, ds.main_key_hi, full),
-         jnp.where(dk_valid, ds.delta_key_hi, full)]
-    )
-    akl = jnp.concatenate(
-        [jnp.where(mk_valid, ds.main_key_lo, full),
-         jnp.where(dk_valid, ds.delta_key_lo, full)]
-    )
-    avh = jnp.concatenate([ds.main_val_hi, ds.delta_val_hi])
-    avl = jnp.concatenate([ds.main_val_lo, ds.delta_val_lo])
-    mkh, mkl, mvh, mvl = jax.lax.sort((akh, akl, avh, avl), num_keys=2)
+    if _via_packed():
+        full64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        ak64 = jnp.concatenate(
+            [jnp.where(mk_valid, _pack64(ds.main_key_hi, ds.main_key_lo, jnp),
+                       full64),
+             jnp.where(dk_valid, _pack64(ds.delta_key_hi, ds.delta_key_lo, jnp),
+                       full64)]
+        )
+        av64 = jnp.concatenate(
+            [_pack64(ds.main_val_hi, ds.main_val_lo, jnp),
+             _pack64(ds.delta_val_hi, ds.delta_val_lo, jnp)]
+        )
+        mk64, mv64 = jax.lax.sort((ak64, av64), num_keys=1)
+        mkh, mkl = _unpack64(mk64, jnp)
+        mvh, mvl = _unpack64(mv64, jnp)
+    else:
+        akh = jnp.concatenate(
+            [jnp.where(mk_valid, ds.main_key_hi, full),
+             jnp.where(dk_valid, ds.delta_key_hi, full)]
+        )
+        akl = jnp.concatenate(
+            [jnp.where(mk_valid, ds.main_key_lo, full),
+             jnp.where(dk_valid, ds.delta_key_lo, full)]
+        )
+        avh = jnp.concatenate([ds.main_val_hi, ds.delta_val_hi])
+        avl = jnp.concatenate([ds.main_val_lo, ds.delta_val_lo])
+        mkh, mkl, mvh, mvl = jax.lax.sort((akh, akl, avh, avl), num_keys=2)
     n_new_main = ds.n_main + ds.n_delta
     overflow = n_new_main > C
     row_ok = jnp.arange(C) < jnp.minimum(n_new_main, C)

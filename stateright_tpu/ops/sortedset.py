@@ -52,6 +52,15 @@ import numpy as np
 #: env var makes the on-chip A/B a process restart.
 VALUES_VIA = os.environ.get("STPU_SORTEDSET_VALUES", "auto")
 
+#: Key/value lane width for the insert's sorts: ``"pair"`` keeps the
+#: (hi, lo) u32 planes (3 key operands + 2 payloads); ``"packed"`` folds
+#: them into u64 lanes (2 keys + 1 payload — ~40% fewer sorted
+#: lane-bytes IF the backend sorts u64 at u32 rates; CPU measured 0.62x,
+#: tools/sortbench.py). Packed mode requires ``jax_enable_x64`` and the
+#: sort-values family; results are bit-identical either way
+#: (differential-tested). Trace-time constant like VALUES_VIA.
+KEYS_VIA = os.environ.get("STPU_SORTEDSET_KEYS", "pair")
+
 
 def _via_sort() -> bool:
     if VALUES_VIA == "auto":
@@ -59,6 +68,33 @@ def _via_sort() -> bool:
 
         return jax.default_backend() != "cpu"
     return VALUES_VIA == "sort"
+
+
+def _pack64(hi, lo, jnp):
+    """(hi, lo) u32 pair -> one u64 lane, ordering-preserving."""
+    return (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+
+
+def _unpack64(x, jnp):
+    return (x >> 32).astype(jnp.uint32), x.astype(jnp.uint32)
+
+
+def _via_packed() -> bool:
+    if KEYS_VIA != "packed":
+        return False
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise ValueError(
+            "STPU_SORTEDSET_KEYS=packed requires jax_enable_x64 (u64 sort "
+            "lanes); enable it before first backend use"
+        )
+    if not _via_sort():
+        raise ValueError(
+            "STPU_SORTEDSET_KEYS=packed composes with the sort-values "
+            "family only (STPU_SORTEDSET_VALUES=sort)"
+        )
+    return True
 
 
 class SortedSet(NamedTuple):
@@ -146,7 +182,20 @@ def insert(
     ticket = jnp.arange(cap + m, dtype=jnp.int32)
 
     via_sort = _via_sort()
-    if via_sort:
+    via_packed = _via_packed()
+    if via_packed:
+        # u64-folded lanes: (key64, ticket) as keys, value64 as payload —
+        # 3 operands instead of 5 on the dominant merge sort. The u64
+        # key orders exactly as the (hi, lo) pair; the all-ones pad maps
+        # to the all-ones u64.
+        k64 = (kh.astype(jnp.uint64) << 32) | kl.astype(jnp.uint64)
+        v64 = (
+            jnp.concatenate([ss.val_hi, val_hi]).astype(jnp.uint64) << 32
+        ) | jnp.concatenate([ss.val_lo, val_lo]).astype(jnp.uint64)
+        sk64, st, sv64 = jax.lax.sort((k64, ticket, v64), num_keys=2)
+        skh = (sk64 >> 32).astype(jnp.uint32)
+        skl = sk64.astype(jnp.uint32)
+    elif via_sort:
         vh = jnp.concatenate([ss.val_hi, val_hi])
         vl = jnp.concatenate([ss.val_lo, val_lo])
         skh, skl, st, svh, svl = jax.lax.sort((kh, kl, ticket, vh, vl), num_keys=3)
@@ -169,7 +218,16 @@ def insert(
     # Stable compaction of survivors to the front keeps them key-sorted.
     row_ok = jnp.arange(cap) < jnp.minimum(new_n, cap)
     z = jnp.uint32(0)
-    if via_sort:
+    if via_packed:
+        ckey = jnp.where(keep, jnp.int32(0), jnp.int32(1))
+        _, ck64, cv64 = jax.lax.sort(
+            (ckey, sk64, sv64), num_keys=1, is_stable=True
+        )
+        nkh = jnp.where(row_ok, (ck64[:cap] >> 32).astype(jnp.uint32), z)
+        nkl = jnp.where(row_ok, ck64[:cap].astype(jnp.uint32), z)
+        nvh = jnp.where(row_ok, (cv64[:cap] >> 32).astype(jnp.uint32), z)
+        nvl = jnp.where(row_ok, cv64[:cap].astype(jnp.uint32), z)
+    elif via_sort:
         # Payload-through-sort: the compaction permutation moves every
         # plane inside one more sort (keep-rank is the key), no gathers.
         ckey = jnp.where(keep, jnp.int32(0), jnp.int32(1))

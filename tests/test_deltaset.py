@@ -227,3 +227,35 @@ def test_engine_delta_flushes_during_tail_shrink(monkeypatch):
     # And the tier was grown past its starting 256 rows by the cascade.
     assert c._table.delta_capacity > 256
     assert_tail_downshift(c.dispatch_log)
+
+
+def test_delta_insert_packed_keys_match_pair(monkeypatch):
+    """The u64 key-packing knob reaches all three of deltaset's sorts
+    (prologue, delta merge, maintain) — bit-identical to the pair
+    lowering, never a silent fallback."""
+    import jax
+
+    monkeypatch.setattr(sortedset, "VALUES_VIA", "sort")
+    rng = np.random.default_rng(47)
+    dl_a = deltaset.make(1 << 11, jnp)
+    dl_b = deltaset.make(1 << 11, jnp)
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        for rnd in range(6):
+            hi, lo, vh, vl, act = _rand_batch(rng, 257, 300)
+            monkeypatch.setattr(sortedset, "KEYS_VIA", "pair")
+            dl_a, new_a, ovf_a = deltaset.insert(dl_a, hi, lo, vh, vl, act)
+            monkeypatch.setattr(sortedset, "KEYS_VIA", "packed")
+            dl_b, new_b, ovf_b = deltaset.insert(dl_b, hi, lo, vh, vl, act)
+            for a, b in zip(dl_a, dl_b):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), rnd
+            assert np.array_equal(np.asarray(new_a), np.asarray(new_b)), rnd
+            assert bool(ovf_a) == bool(ovf_b)
+        fa, _ = deltaset.maintain(dl_a)
+        monkeypatch.setattr(sortedset, "KEYS_VIA", "pair")
+        fb, _ = deltaset.maintain(dl_b)
+        for a, b in zip(fa, fb):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)

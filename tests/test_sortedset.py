@@ -242,3 +242,54 @@ def test_engine_compaction_lowerings_match():
         assert set(da) == set(db), mode
         for name in da:
             assert da[name].into_states() == db[name].into_states(), mode
+
+
+def test_insert_packed_keys_match_pair(monkeypatch):
+    """STPU_SORTEDSET_KEYS=packed (u64-folded key/value lanes, 3 sort
+    operands) is bit-identical to the u32-pair lowering. Needs x64 for
+    the u64 lanes; restored after."""
+    import jax
+
+    monkeypatch.setattr(sortedset, "VALUES_VIA", "sort")
+    rng = np.random.default_rng(41)
+    ss_a = sortedset.make(1 << 11, jnp)
+    ss_b = sortedset.make(1 << 11, jnp)
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        for rnd in range(6):
+            hi, lo, vh, vl, act = _rand_batch(rng, 257, 300)
+            monkeypatch.setattr(sortedset, "KEYS_VIA", "pair")
+            ss_a, new_a, ovf_a = sortedset.insert(ss_a, hi, lo, vh, vl, act)
+            monkeypatch.setattr(sortedset, "KEYS_VIA", "packed")
+            ss_b, new_b, ovf_b = sortedset.insert(ss_b, hi, lo, vh, vl, act)
+            for a, b in zip(ss_a, ss_b):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), rnd
+            assert np.array_equal(np.asarray(new_a), np.asarray(new_b)), rnd
+            assert bool(ovf_a) == bool(ovf_b)
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
+
+
+def test_packed_keys_guardrails(monkeypatch):
+    """packed without x64 or with the gather values family must raise,
+    not silently truncate keys to 32 bits."""
+    import jax
+
+    import pytest as _pytest
+
+    monkeypatch.setattr(sortedset, "KEYS_VIA", "packed")
+    monkeypatch.setattr(sortedset, "VALUES_VIA", "sort")
+    rng = np.random.default_rng(43)
+    ss = sortedset.make(1 << 8, jnp)
+    hi, lo, vh, vl, act = _rand_batch(rng, 65, 300)
+    with _pytest.raises(ValueError, match="x64"):
+        sortedset.insert(ss, hi, lo, vh, vl, act)
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        monkeypatch.setattr(sortedset, "VALUES_VIA", "gather")
+        with _pytest.raises(ValueError, match="sort-values"):
+            sortedset.insert(ss, hi, lo, vh, vl, act)
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
