@@ -46,6 +46,10 @@ while true; do
     rc2p=$?
     log "pallas_compact rc=$rc2p: $(tail -c 200 tpu_pallas_compact.log 2>/dev/null)"
     git add -f tpu_pallas_compact.log >>"$LOG" 2>&1
+    timeout 2400 python tools/packed_ab.py 8 >tpu_packed_ab.log 2>&1
+    rc2k=$?
+    log "packed_ab rc=$rc2k: $(tail -c 300 tpu_packed_ab.log 2>/dev/null)"
+    git add -f tpu_packed_ab.log >>"$LOG" 2>&1
     timeout 2700 python tools/profile_superstep.py 8 >tpu_profile_r5c.log 2>&1
     rc2=$?
     log "profile rc=$rc2"
