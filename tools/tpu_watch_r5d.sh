@@ -1,0 +1,105 @@
+#!/usr/bin/env bash
+# Round-5d tunnel watcher — v2 of tools/tpu_watch_r5c.sh after the
+# 04:19 window: the tunnel wedged mid-compile of the delta+pallas stack
+# bench and the v1 watcher would have burned every later stage's
+# timeout against the dead tunnel before re-probing. Changes:
+#   * probe the tunnel BEFORE each stage; if it is down, return to the
+#     wait loop instead of running the remaining stages into timeouts
+#   * stage-completion markers (.r5d_markers/) so a later window skips
+#     what an earlier one finished — short windows make progress
+#   * the combined delta+pallas stack bench is split into delta-only,
+#     pallas-only, then stack, each committed separately: if a lowering
+#     wedges the chip we learn WHICH one, and the winners are
+#     attributable (the defaults decision needs per-knob numbers)
+#   * the cheap pallas synthetic probe runs first — the pallas kernel
+#     has never executed on real silicon and is the prime wedge suspect
+# bench.py falls back to CPU when the tunnel dies, so bench stages only
+# count as done when the emitted JSON line says tpu.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch_r5d.log
+MARK=.r5d_markers
+mkdir -p "$MARK"
+log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+probe() { timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; }
+commit_stage() {
+  local msg=$1 f; shift
+  for f in "$@" "$LOG"; do
+    git add -f -- "$f" >>"$LOG" 2>&1 || log "artifact missing: $f"
+  done
+  git commit -q -m "$msg" >>"$LOG" 2>&1 && log "committed: $msg"
+}
+done_p() { [ -f "$MARK/$1" ]; }
+mark() { touch "$MARK/$1"; }
+
+# run_tool NAME TIMEOUT LOGFILE CMD... — marker on rc==0 (the axon
+# platform is pinned by sitecustomize, so a tool that ran to rc==0 ran
+# on the chip; a wedge times out and leaves no marker).
+run_tool() {
+  local name=$1 tmo=$2 out=$3; shift 3
+  done_p "$name" && { log "skip $name (done)"; return 0; }
+  probe || { log "tunnel down before $name; back to wait"; return 1; }
+  log "stage $name: $*"
+  timeout "$tmo" "$@" >"$out" 2>&1
+  local rc=$?
+  log "$name rc=$rc: $(tail -c 250 "$out" 2>/dev/null)"
+  [ $rc -eq 0 ] && mark "$name"
+  commit_stage "TPU r5d $name (rc=$rc)" "$out"
+  return 0
+}
+
+# run_bench NAME TIMEOUT OUTJSON ENV... — marker needs rc==0 AND a tpu
+# JSON line (bench.py silently falls back to a cpu worker otherwise).
+run_bench() {
+  local name=$1 tmo=$2 out=$3; shift 3
+  done_p "$name" && { log "skip $name (done)"; return 0; }
+  probe || { log "tunnel down before $name; back to wait"; return 1; }
+  log "stage $name: bench.py $*"
+  timeout "$tmo" env "$@" python bench.py >"$out" 2>>"$LOG"
+  local rc=$?
+  log "$name rc=$rc: $(tail -c 300 "$out" 2>/dev/null)"
+  if [ $rc -eq 0 ] && grep -q '"tpu"' "$out"; then mark "$name"; fi
+  commit_stage "TPU r5d $name (rc=$rc)" "$out" bench_detail.json bench_probe.log
+  return 0
+}
+
+log "watcher v2 started (pid $$)"
+while true; do
+  if probe; then
+    log "TUNNEL UP — staged pass"
+    # 0. pallas synthetic probe — never run on silicon; prime wedge suspect
+    run_tool pallas_probe 1200 tpu_pallas_compact.log \
+      python tools/pallas_compact.py || { sleep 240; continue; }
+    # 1. delta-only bench (headline config, no matrix)
+    run_bench bench_delta 2400 bench_r5d_delta.json \
+      BENCH_DEDUP=delta BENCH_MATRIX=0 || { sleep 240; continue; }
+    # 2. pallas-only bench
+    run_bench bench_pallas 2400 bench_r5d_pallas.json \
+      STPU_COMPACTION=pallas BENCH_MATRIX=0 || { sleep 240; continue; }
+    # 3. full attack stack
+    run_bench bench_stack 2400 bench_r5d_stack.json \
+      BENCH_DEDUP=delta STPU_COMPACTION=pallas BENCH_MATRIX=0 || { sleep 240; continue; }
+    # 4. superstep profile incl. mixed-lowering A/B rows
+    run_tool profile 2700 tpu_profile_r5c.log \
+      python tools/profile_superstep.py 8 || { sleep 240; continue; }
+    # 5. sort-dtype A/B (key packing decision)
+    run_tool sortbench 1200 tpu_sortbench.log \
+      python tools/sortbench.py 23 || { sleep 240; continue; }
+    # 6. engine-level packed-keys A/B
+    run_tool packed_ab 2400 tpu_packed_ab.log \
+      python tools/packed_ab.py 8 || { sleep 240; continue; }
+    # 7. scale soak rm=10/11 + paxos 3c/3s + delta retries
+    run_tool soak 7200 tpu_soak_r5d.log \
+      python tools/tpu_soak.py --skip-rm9 || { sleep 240; continue; }
+    if done_p pallas_probe && done_p bench_delta && done_p bench_pallas \
+       && done_p bench_stack && done_p profile && done_p sortbench \
+       && done_p packed_ab && done_p soak; then
+      log "all stages done; watcher exiting"
+      exit 0
+    fi
+    log "pass finished with unfinished stages; resuming watch"
+  else
+    log "tunnel down"
+  fi
+  sleep 240
+done
