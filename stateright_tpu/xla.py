@@ -142,6 +142,7 @@ class XlaChecker(Checker):
         dedup: str = "auto",
         compaction: str = "auto",
         ladder: str = "auto",
+        shrink_exit: str = "auto",
     ):
         import jax
 
@@ -251,6 +252,28 @@ class XlaChecker(Checker):
         if ladder not in ("jump", "ramp"):
             raise ValueError(f"ladder must be 'auto', 'jump', or 'ramp': {ladder!r}")
         self._ladder = ladder
+        # Tail shrink-exit policy. The downshift is a pure host-side
+        # dispatch decision — the threshold rides into the compiled
+        # program as a runtime scalar — so this knob never costs a
+        # compile. "auto": on for CPU, off for accelerators. Each tail
+        # downshift is an extra host round-trip, and on the
+        # tunnel-attached TPU the rm=8 A/B (2026-08-02) measured the
+        # ~7 tail round-trips at ~1.1 s against ~0.15 s of grid-sort
+        # savings (2.13 M -> 1.88 M gen/s, same schedule, same counts);
+        # on 1-core CPU dispatch is ~free and the snug tail sorts won
+        # (rm=6 ramp tail 16384 -> 4096 -> 1024 -> 256). A
+        # locally-attached TPU with sub-ms dispatch may want
+        # shrink_exit="on" — hence a knob, not a hard-coding.
+        # STPU_SHRINK_EXIT makes the A/B a process restart.
+        if shrink_exit == "auto":
+            shrink_exit = os.environ.get("STPU_SHRINK_EXIT") or (
+                "on" if jax.default_backend() == "cpu" else "off"
+            )
+        if shrink_exit not in ("on", "off"):
+            raise ValueError(
+                f"shrink_exit must be 'auto', 'on', or 'off': {shrink_exit!r}"
+            )
+        self._shrink_exit = shrink_exit == "on"
         # Expand-stage layout (attack 2 of the BASELINE roadmap; A/B knob
         # for the chip window). "rows" materializes the [F, A, W] grid the
         # vmap naturally produces, then transposes to [W, A*F] planes —
@@ -1642,7 +1665,7 @@ class XlaChecker(Checker):
             # reuses that program, so this can never trigger a compile.
             # Tiny buckets aren't worth the extra host round-trip.
             shrink_below = 0
-            if run_cap > 256:
+            if self._shrink_exit and run_cap > 256:
                 smaller = [c for c in self._compiled_run_caps() if c < run_cap]
                 if smaller:
                     shrink_below = max(smaller) // 4

@@ -189,3 +189,24 @@ def test_overflow_grow_never_stalls_at_level_zero():
     # Dequeue-time depth bookkeeping (bfs.rs:257-272): the terminal
     # leaves' frontier is counted at depth 2 before being found empty.
     assert checker.max_depth() == 2
+
+
+def test_shrink_exit_off_never_downshifts():
+    """``shrink_exit='off'`` (the accelerator auto: each tail downshift
+    is a host round-trip, and over the TPU tunnel the rm=8 A/B measured
+    the re-dispatch RTT above the snug-sort savings) must keep the
+    dispatch caps nondecreasing with counts unchanged."""
+    model = PackedTwoPhaseSys(4)
+    checker = model.checker().spawn_xla(
+        ladder="ramp", shrink_exit="off", **KW
+    )
+    while not checker.is_done():
+        checker._run_block()
+    assert (checker.state_count(), checker.unique_state_count()) == (8_258, 1_568)
+    caps = [cap for cap, _ in checker.dispatch_log]
+    assert caps == sorted(caps), checker.dispatch_log
+
+
+def test_shrink_exit_validation():
+    with pytest.raises(ValueError, match="shrink_exit"):
+        PackedTwoPhaseSys(3).checker().spawn_xla(shrink_exit="maybe", **KW)

@@ -58,7 +58,7 @@ run_bench() {
   timeout "$tmo" env "$@" python bench.py >"$out" 2>>"$LOG"
   local rc=$?
   log "$name rc=$rc: $(tail -c 300 "$out" 2>/dev/null)"
-  if [ $rc -eq 0 ] && grep -q '"tpu"' "$out"; then mark "$name"; fi
+  if [ $rc -eq 0 ] && grep -q 'spawn_xla, tpu' "$out"; then mark "$name"; fi
   commit_stage "TPU r5d $name (rc=$rc)" "$out" bench_detail.json bench_probe.log
   return 0
 }
