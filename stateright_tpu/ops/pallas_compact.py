@@ -60,8 +60,22 @@ def _block_compact(mask_ref, plane_refs, B: int):
 
     P = len(plane_refs)
     m = mask_ref[:].astype(jnp.int32)
-    incl = jnp.cumsum(m)
-    n_b = incl[B - 1]
+    # Inclusive prefix sum as a lower-triangular [B, B] contraction:
+    # Mosaic has no cumsum lowering inside TC kernels (first-silicon
+    # probe, 2026-08-02), and the MXU form is the TPU-native prefix sum
+    # anyway. 0/1 operands with <=B-term f32 accumulation are exact at
+    # HIGHEST (same argument as the payload gather below).
+    ii = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
+    tri = (ii >= jj).astype(jnp.float32)
+    incl = jax.lax.dot_general(
+        tri,
+        m.astype(jnp.float32).reshape(B, 1),
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).reshape(B).astype(jnp.int32)
+    n_b = jnp.sum(m)
     j = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
     i_rank = jnp.where(m > 0, incl - 1, -1)
     sel = (j == i_rank[None, :]).astype(jnp.float32)
