@@ -76,12 +76,13 @@ def _block_compact(mask_ref, plane_refs, B: int):
         preferred_element_type=jnp.float32,
     ).reshape(B).astype(jnp.int32)
     n_b = jnp.sum(m)
-    j = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
     i_rank = jnp.where(m > 0, incl - 1, -1)
-    sel = (j == i_rank[None, :]).astype(jnp.float32)
+    sel = (ii == i_rank[None, :]).astype(jnp.float32)
     blk = jnp.stack([r[:] for r in plane_refs])  # [P, B], VMEM-local
-    lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.float32)
-    hi16 = (blk >> jnp.uint32(16)).astype(jnp.float32)
+    # Mosaic has no direct u32<->f32 cast; both halves are <= 0xFFFF so
+    # the i32 hop is value-exact in each direction.
+    lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
+    hi16 = (blk >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
     gathered = jax.lax.dot_general(
         sel,
         jnp.concatenate([lo16, hi16], axis=0).T,
@@ -91,8 +92,8 @@ def _block_compact(mask_ref, plane_refs, B: int):
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
-    compacted = gathered[:, :P].T.astype(jnp.uint32) | (
-        gathered[:, P:].T.astype(jnp.uint32) << jnp.uint32(16)
+    compacted = gathered[:, :P].T.astype(jnp.int32).astype(jnp.uint32) | (
+        gathered[:, P:].T.astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
     )
     return compacted, n_b
 
