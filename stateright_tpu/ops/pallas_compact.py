@@ -10,31 +10,50 @@ lives in SMEM scratch across grid steps and every HBM write is a
 contiguous, B-aligned chunk DMA — no scatters
 (docs/backend_pathologies.md #2/#5 never enter the picture).
 
-Per block b of B lanes:
-  1. local ranks: inclusive cumsum of the mask block,
-  2. in-VMEM block compaction: output slot j pulls the lane holding the
-     (j+1)-th set bit via a one-hot [B, B] contraction at
-     ``Precision.HIGHEST`` — each output sums exactly ONE nonzero
-     product of 16-bit-valued f32s, so the result is exact; the default
-     bf16 MXU pass would silently truncate the u16 halves (8-bit
-     mantissa), which is why the precision pin is load-bearing,
-  3. survivors append into a [P, 2B] VMEM ring at the running offset;
-     full B-aligned chunks DMA to the HBM output,
-  4. the garbage tail of each chunk is overwritten by the next flush
-     (sequential grid = no race); lanes at and past the total survivor
-     count are UNSPECIFIED — callers re-mask (the engine's zero-pad
-     contract is applied outside the kernel).
+Mosaic constrains the design twice over (registry #6 and the r5e
+first-silicon compile): there is no ``cumsum`` lowering inside TC
+kernels, and a ``vector_store`` at a DYNAMIC lane offset must be
+provably 128-aligned — so the obvious "compact to block front, store at
+running offset p" shape does not compile. Both land on the same
+TPU-native answer, the MXU one-hot contraction:
+
+Per block b of B lanes (ring state: ``stage`` [P, 2B] VMEM, SMEM carry
+``(t, c)`` = survivors appended / chunks flushed, ``p = t - c*B``):
+  1. local ranks: inclusive prefix sum of the mask block as a
+     lower-triangular [B, B] contraction (0/1 operands, f32
+     accumulation at ``Precision.HIGHEST`` — exact at any plausible B),
+  2. ring-targeted scatter-as-matmul: survivor s of the block belongs
+     at ring position ``i_rank[s] + p``; ``sel[s, j] = (j == i_rank[s]
+     + p)`` is a [B, 2B] one-hot, and ``(lanes as two f32 16-bit
+     halves) @ sel`` lands every survivor in place in one MXU pass.
+     Each output column sums at most ONE nonzero product of
+     16-bit-valued f32s, so the result is exact; the default bf16 MXU
+     pass would silently truncate the u16 halves (8-bit mantissa) —
+     the precision pin is load-bearing,
+  3. the ring updates as a full aligned read-modify-write:
+     ``stage = where(hit, contrib, stage)`` with ``hit`` = sel's
+     column-any — no dynamic-offset store exists in the program,
+  4. full B-chunks DMA to the output at ``c*B`` (chunk-aligned by
+     construction) and the ring slides by one static B; the garbage
+     tail past the total survivor count is UNSPECIFIED — callers
+     re-mask (the engine's zero-pad contract is applied outside).
+
+Overflow (survivors past ``cap``) is drop-safe by construction: once
+flushing freezes at the cap, ``p`` grows past 2B and every sel column
+test fails — nothing is written, nothing is out of bounds.
 
 Inputs are SEPARATE 1-D lane refs (not one stacked [P, M] array): the
 engine's lanes already exist as independent buffers, and a pre-kernel
 ``jnp.stack`` would cost a full extra read+write of the grid — against
 the kernel's whole point.
 
-``compact_pallas`` keeps the output VMEM-resident (probe/testing shape);
-``compact_pallas_staged`` is the engine-scale variant. Equality against
-the sort lowering is pinned by ``tests/test_pallas_compact.py`` and the
-engine differential; whether it is FASTER on chip is the
-``tools/pallas_compact.py`` A/B's question.
+``compact_pallas_staged`` is the kernel; ``compact_pallas`` is a
+same-contract delegate kept for the probe and tests (the former
+separate VMEM-output kernel died in the rework — its dynamic-offset
+output store was the rejected shape). Equality against the sort
+lowering is pinned by
+``tests/test_pallas_compact.py`` and the engine differential; whether
+it is FASTER on chip is the ``tools/pallas_compact.py`` A/B's question.
 """
 
 from __future__ import annotations
@@ -51,20 +70,20 @@ def _as_lanes(planes):
     return list(planes)
 
 
-def _block_compact(mask_ref, plane_refs, B: int):
-    """Shared block body: local compaction of P lane blocks [B] by a [B]
-    mask block via the one-hot contraction. Returns ``(compacted [P, B],
-    n_b)`` — survivors dense at the front, tail unspecified."""
+def _ring_update(mask_ref, plane_refs, stage, p, B: int):
+    """Shared block body: fold this block's survivors into the [P, 2B]
+    VMEM ring at running offset ``p`` via the ring-targeted one-hot
+    contraction (module docstring steps 1–3). Returns ``n_b``, the
+    block's survivor count. Survivors whose target position would fall
+    at or past 2B (flush frozen at the cap) match no column and are
+    dropped without any out-of-bounds access."""
     import jax
     import jax.numpy as jnp
 
     P = len(plane_refs)
     m = mask_ref[:].astype(jnp.int32)
     # Inclusive prefix sum as a lower-triangular [B, B] contraction:
-    # Mosaic has no cumsum lowering inside TC kernels (first-silicon
-    # probe, 2026-08-02), and the MXU form is the TPU-native prefix sum
-    # anyway. 0/1 operands with <=B-term f32 accumulation are exact at
-    # HIGHEST (same argument as the payload gather below).
+    # Mosaic has no cumsum lowering inside TC kernels (registry #6).
     ii = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
     jj = jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
     tri = (ii >= jj).astype(jnp.float32)
@@ -76,82 +95,41 @@ def _block_compact(mask_ref, plane_refs, B: int):
         preferred_element_type=jnp.float32,
     ).reshape(B).astype(jnp.int32)
     n_b = jnp.sum(m)
-    i_rank = jnp.where(m > 0, incl - 1, -1)
-    sel = (ii == i_rank[None, :]).astype(jnp.float32)
+    # Ring target of each survivor; non-survivors aim at -1 (no column).
+    tgt = jnp.where(m > 0, incl - 1 + p, -1)
+    jr = jax.lax.broadcasted_iota(jnp.int32, (B, 2 * B), 1)
+    sel = (jr == tgt.reshape(B, 1)).astype(jnp.float32)
     blk = jnp.stack([r[:] for r in plane_refs])  # [P, B], VMEM-local
     # Mosaic has no direct u32<->f32 cast; both halves are <= 0xFFFF so
-    # the i32 hop is value-exact in each direction.
+    # the i32 hop is value-exact in each direction (registry #6).
     lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
     hi16 = (blk >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
-    gathered = jax.lax.dot_general(
-        sel,
-        jnp.concatenate([lo16, hi16], axis=0).T,
+    contrib = jax.lax.dot_general(
+        jnp.concatenate([lo16, hi16], axis=0),  # [2P, B]
+        sel,  # [B, 2B]
         (((1,), (0,)), ((), ())),
         # Exactness pin — see the module docstring. DEFAULT would run a
         # single bf16 pass and truncate the 16-bit payload halves.
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
+    )  # [2P, 2B]
+    packed = contrib[:P].astype(jnp.int32).astype(jnp.uint32) | (
+        contrib[P:].astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
     )
-    compacted = gathered[:, :P].T.astype(jnp.int32).astype(jnp.uint32) | (
-        gathered[:, P:].T.astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
-    )
-    return compacted, n_b
-
-
-def compact_pallas(
-    mask, planes, cap: int, *, block: int = 1024, interpret: bool = False
-):
-    """Order-preserving stream compaction of P uint32 lanes [M] by
-    ``mask`` [M] into [P, cap], output VMEM-resident (small caps only).
-    Lanes at index >= sum(mask) are UNSPECIFIED. M and cap must be
-    multiples of ``block``."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    lanes = _as_lanes(planes)
-    P = len(lanes)
-    M = lanes[0].shape[0]
-    assert mask.shape == (M,)
-    assert M % block == 0 and cap % block == 0, (M, cap, block)
-
-    def kernel(mask_ref, *rest):
-        plane_refs, out_ref, off_ref = rest[:P], rest[P], rest[P + 1]
-        b = pl.program_id(0)
-
-        @pl.when(b == 0)
-        def _init():
-            off_ref[0] = 0
-
-        compacted, n_b = _block_compact(mask_ref, plane_refs, block)
-        off = off_ref[0]
-
-        @pl.when(off + block <= cap)
-        def _store():
-            out_ref[:, pl.ds(off, block)] = compacted
-
-        off_ref[0] = off + n_b
-
-    lane_spec = pl.BlockSpec((block,), lambda b: (b,))
-    return pl.pallas_call(
-        kernel,
-        grid=(M // block,),
-        in_specs=[lane_spec] * (1 + P),
-        out_specs=pl.BlockSpec((P, cap), lambda b: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((P, cap), lanes[0].dtype),
-        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
-        interpret=interpret,
-    )(mask, *lanes)
+    hit = jnp.sum(sel, axis=0, keepdims=True) > 0.5  # [1, 2B]
+    stage[:, :] = jnp.where(hit, packed, stage[:, :])
+    return n_b
 
 
 def compact_pallas_staged(
-    mask, planes, cap: int, *, block: int = 1024, interpret: bool = False
+    mask, planes, cap: int, *, block: int = 512, interpret: bool = False
 ):
-    """The engine-scale variant: output lives in HBM; survivors stream
-    through a [P, 2B] VMEM ring and flush to the output in B-aligned
-    chunk DMAs. SMEM carries (total appended, flushed chunks) across the
-    sequential grid. Unspecified lanes as in :func:`compact_pallas`."""
+    """Order-preserving stream compaction of P uint32 lanes [M] by
+    ``mask`` [M] into [P, cap] (HBM output): survivors stream through a
+    [P, 2B] VMEM ring and flush to the output in B-aligned chunk DMAs.
+    SMEM carries (total appended, flushed chunks) across the sequential
+    grid. Lanes at index >= sum(mask) are UNSPECIFIED — callers mask.
+    M and cap must be multiples of ``block``."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -175,18 +153,9 @@ def compact_pallas_staged(
             cnt[0] = 0  # survivors appended
             cnt[1] = 0  # chunks flushed
 
-        compacted, n_b = _block_compact(mask_ref, plane_refs, B)
         t, c = cnt[0], cnt[1]
         p = t - c * B  # append position within the ring, in [0, B)
-
-        # Once flushing is frozen at the cap (survivor overflow — the
-        # engine discards and retries the level), t keeps growing while
-        # c does not; appending would then address past the 2B ring.
-        # Mosaic documents OOB access as undefined behavior, so skip.
-        @pl.when(p + B <= 2 * B)
-        def _append():
-            stage[:, pl.ds(p, B)] = compacted
-
+        n_b = _ring_update(mask_ref, plane_refs, stage, p, B)
         t = t + n_b
         cnt[0] = t
 
@@ -228,3 +197,15 @@ def compact_pallas_staged(
         ],
         interpret=interpret,
     )(mask, *lanes)
+
+
+def compact_pallas(
+    mask, planes, cap: int, *, block: int = 512, interpret: bool = False
+):
+    """Small-cap convenience form of :func:`compact_pallas_staged` (the
+    r5e Mosaic rework collapsed the separate VMEM-output kernel: its
+    dynamic-offset output store was the exact shape Mosaic rejects, and
+    the ring+DMA scheme subsumes it). Same contract."""
+    return compact_pallas_staged(
+        mask, planes, cap, block=block, interpret=interpret
+    )

@@ -837,7 +837,11 @@ class XlaChecker(Checker):
         # at smaller shapes — tests use this) and interpret mode (the
         # kernel has no CPU lowering; the interpreter is the CPU
         # reference semantics).
-        pallas_block = int(os.environ.get("STPU_PALLAS_BLOCK", "1024"))
+        # Default 512: the r5e ring-targeted kernel holds a [B, 2B] f32
+        # one-hot plus a [B, B] triangular operand in VMEM — ~3 MB at
+        # B=512 vs ~12 MB at B=1024, which crowds the ~16 MB/core budget
+        # before the stage ring and lane blocks.
+        pallas_block = int(os.environ.get("STPU_PALLAS_BLOCK", "512"))
         pallas_interp = jax.default_backend() == "cpu"
 
         def compact_1d(mask, cap, arrays, prio=None, rows_out=()):

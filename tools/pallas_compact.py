@@ -11,16 +11,20 @@ and each block writes its survivors with one dynamic-offset contiguous
 store — no scatters (the XLA:TPU scatter pathologies, see
 docs/backend_pathologies.md, never enter the picture).
 
-Block scheme (block size B, grid step b):
+Block scheme (block size B, grid step b; the r5e Mosaic rework — the
+original "compact to block front, store at running offset" shape is
+exactly the dynamic-offset ``vector_store`` Mosaic rejects, see
+docs/backend_pathologies.md #6 and the ops/pallas_compact.py module
+docstring for the full constraint story):
   1. load mask block [B], planes block [P, B] (VMEM),
-  2. local ranks: exclusive cumsum of the mask,
-  3. in-VMEM compaction of the block: each output slot j pulls the
-     lane holding the (j+1)-th set bit (iota-compare one-hot matmul —
-     MXU-friendly — or a VMEM gather; both are block-local),
-  4. store [P, B] at out[:, pl.ds(offset, B)] — the first n_b lanes are
-     real, the garbage tail is OVERWRITTEN by the next block because
-     offset advances by n_b (sequential grid = no race),
-  5. offset += n_b (SMEM carry).
+  2. local ranks: inclusive prefix sum as a triangular [B, B] MXU
+     contraction (Mosaic has no in-kernel cumsum),
+  3. ring-targeted scatter-as-matmul: a [B, 2B] one-hot aims survivor
+     s at ring position ``rank[s] + p``; one MXU pass lands every
+     survivor in place in a [P, 2B] VMEM ring updated by a full
+     aligned read-modify-write,
+  4. full B-chunks DMA to the output at chunk-aligned offsets; the
+     ring slides by one static B (SMEM carries the running counts).
 Lanes past the total survivor count are garbage the caller masks (the
 engine already masks by ``n_valid``, same as the sort lowerings).
 
@@ -113,10 +117,11 @@ def main() -> None:
         mask = jnp.asarray(mask_np)
         planes = jnp.asarray(planes_np)
 
-        f_pal = jax.jit(functools.partial(compact_pallas, cap=cap, block=B))
+        # compact_pallas is a delegate of the staged kernel since the
+        # r5e rework — one row per distinct compiled program.
         f_stg = jax.jit(functools.partial(compact_pallas_staged, cap=cap, block=B))
         f_sort = jax.jit(functools.partial(_sort_compact, cap=cap))
-        for name, fn in (("pallas", f_pal), ("staged", f_stg), ("sort", f_sort)):
+        for name, fn in (("staged", f_stg), ("sort", f_sort)):
             try:
                 o = fn(mask, planes)
             except Exception as e:  # lowering failures are a result too
@@ -136,7 +141,9 @@ def main() -> None:
             )
 
     # --- the engine shape: M=2^24 grid lanes, cap=2^22 (out in HBM) -----
-    log2_m, B = 24, 1024
+    # B=512 matches the engine's STPU_PALLAS_BLOCK default (the B=1024
+    # sel+tri operands crowd VMEM — see the xla.py comment).
+    log2_m, B = 24, 512
     M, cap = 1 << log2_m, 1 << 22
     mask_np = rng.integers(0, 8, M) == 0
     planes_np = rng.integers(0, 2**32, (P, M), dtype=np.uint32)
