@@ -287,8 +287,17 @@ def _worker(platform: str) -> None:
     # under its 1/4-load rule — 2^24 keeps an rm=8 A/B run (BENCH_DEDUP=
     # hash) from paying a mid-measurement growth recompile at 2^22, which
     # would skew exactly the hash-vs-sorted comparison the knob exists for.
+    # A pallas/bsearch compaction request forces a planes-engine dedup.
+    # spawn_xla's own auto resolves the same way since r5e (and raises
+    # on an explicit hash + planes-only combination); mirroring it here
+    # keeps the logged/reported dedup truthful.
+    planes_only_compaction = os.environ.get("STPU_COMPACTION") in (
+        "pallas",
+        "bsearch",
+    )
     effective_dedup = os.environ.get("BENCH_DEDUP") or (
-        "hash" if platform == "cpu" else "sorted"
+        "hash" if platform == "cpu" and not planes_only_compaction
+        else "sorted"
     )
     default_table_pow = "24" if effective_dedup == "hash" else "22"
     table_pow = int(os.environ.get("BENCH_TABLE_POW", default_table_pow))
@@ -328,9 +337,14 @@ def _worker(platform: str) -> None:
         or ("ramp" if platform == "cpu" else "jump"),
     )
     # Visited-set structure override (the on-chip A/B: sorted vs delta);
-    # default "auto" = hash on CPU, sorted on accelerators.
+    # default "auto" = hash on CPU, sorted on accelerators — except a
+    # planes-only compaction request, which must pin the planes engine
+    # explicitly (spawn_xla's own auto would pick hash on CPU and
+    # raise).
     if os.environ.get("BENCH_DEDUP"):
         spawn_kwargs["dedup"] = os.environ["BENCH_DEDUP"]
+    elif planes_only_compaction:
+        spawn_kwargs["dedup"] = effective_dedup
     warm_states, warm_sec, _, _ = _run_check(
         model, None, budget_s=warm_budget, **spawn_kwargs
     )

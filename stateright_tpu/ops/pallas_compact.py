@@ -47,9 +47,8 @@ engine's lanes already exist as independent buffers, and a pre-kernel
 ``jnp.stack`` would cost a full extra read+write of the grid — against
 the kernel's whole point.
 
-``compact_pallas_staged`` is the kernel; ``compact_pallas`` is a
-same-contract delegate kept for the probe and tests (the former
-separate VMEM-output kernel died in the rework — its dynamic-offset
+``compact_pallas_staged`` is the kernel (the former separate
+VMEM-output ``compact_pallas`` died in the rework — its dynamic-offset
 output store was the rejected shape). Equality against the sort
 lowering is pinned by
 ``tests/test_pallas_compact.py`` and the engine differential; whether
@@ -199,13 +198,3 @@ def compact_pallas_staged(
     )(mask, *lanes)
 
 
-def compact_pallas(
-    mask, planes, cap: int, *, block: int = 512, interpret: bool = False
-):
-    """Small-cap convenience form of :func:`compact_pallas_staged` (the
-    r5e Mosaic rework collapsed the separate VMEM-output kernel: its
-    dynamic-offset output store was the exact shape Mosaic rejects, and
-    the ring+DMA scheme subsumes it). Same contract."""
-    return compact_pallas_staged(
-        mask, planes, cap, block=block, interpret=interpret
-    )

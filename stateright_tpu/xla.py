@@ -176,8 +176,23 @@ class XlaChecker(Checker):
         # accelerators default to the sort-merge set + gather compaction
         # (ops/sortedset.py) and CPUs keep the hash set + scatter compaction
         # that wins there.
+        # A planes-only compaction request (explicit arg or the
+        # STPU_COMPACTION env A/B knob behind "auto") re-aims the dedup
+        # auto: "bsearch"/"pallas" exist only in the plane-major engine,
+        # and resolving dedup to hash-on-CPU first would reject the
+        # combination the caller asked for (the r5e watcher's CPU
+        # fallback died exactly there).
+        requested_compaction = (
+            os.environ.get("STPU_COMPACTION") or "auto"
+            if compaction == "auto"
+            else compaction
+        )
         if dedup == "auto":
-            dedup = "hash" if jax.default_backend() == "cpu" else "sorted"
+            dedup = (
+                "sorted"
+                if requested_compaction in ("bsearch", "pallas")
+                else "hash" if jax.default_backend() == "cpu" else "sorted"
+            )
         if dedup not in ("hash", "sorted", "delta"):
             raise ValueError(
                 f"dedup must be 'auto', 'hash', 'sorted', or 'delta': {dedup!r}"
@@ -228,11 +243,15 @@ class XlaChecker(Checker):
                 "compaction must be 'auto', 'gather', 'sort', "
                 f"'bsearch', or 'pallas': {compaction!r}"
             )
-        if compaction == "pallas" and not self._soa:
+        if compaction in ("bsearch", "pallas") and not self._soa:
+            # (bsearch included: the rows superstep never consults the
+            # compaction knob, and silently measuring the hash engine
+            # under an STPU_COMPACTION=bsearch A/B would mislabel the
+            # banked numbers.)
             raise ValueError(
-                "compaction='pallas' runs in the plane-major engine: "
-                "pass dedup='sorted' or 'delta' (the hash engine is the "
-                "rows path)"
+                f"compaction={compaction!r} runs in the plane-major "
+                "engine: pass dedup='sorted' or 'delta' (the hash "
+                "engine is the rows path)"
             )
         self._compaction = compaction
         # Bucket-ladder policy. "ramp" steps one power-of-four rung per

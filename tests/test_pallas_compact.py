@@ -12,10 +12,10 @@ import pytest
 
 import jax.numpy as jnp
 
-from stateright_tpu.ops.pallas_compact import compact_pallas, compact_pallas_staged
+from stateright_tpu.ops.pallas_compact import compact_pallas_staged
 
 
-@pytest.mark.parametrize("kernel", [compact_pallas, compact_pallas_staged])
+@pytest.mark.parametrize("kernel", [compact_pallas_staged])
 def test_kernel_matches_numpy(kernel):
     rng = np.random.default_rng(9)
     P, M, cap, B = 5, 1 << 12, 1 << 11, 256

@@ -6,10 +6,11 @@ state-major ("bsearch") flatten is ORDER-PRESERVING stream compaction:
 move the ``mask``-selected lanes of ``[P, M]`` planes to the front of a
 ``[P, cap]`` output. A sort is O(n log^2 n) data passes; a streaming
 kernel is O(n): TPU pallas grids execute blocks SEQUENTIALLY on a core,
-so a running output offset can live in SMEM scratch across grid steps,
-and each block writes its survivors with one dynamic-offset contiguous
-store — no scatters (the XLA:TPU scatter pathologies, see
-docs/backend_pathologies.md, never enter the picture).
+so the running output position lives in SMEM scratch across grid steps
+and survivors land via MXU one-hot contractions + aligned chunk DMAs —
+no scatters and no dynamic-offset vector stores (the XLA:TPU scatter
+pathologies AND the Mosaic alignment prover, docs/backend_pathologies.md
+#2/#6, never enter the picture).
 
 Block scheme (block size B, grid step b; the r5e Mosaic rework — the
 original "compact to block front, store at running offset" shape is
@@ -29,8 +30,8 @@ Lanes past the total survivor count are garbage the caller masks (the
 engine already masks by ``n_valid``, same as the sort lowerings).
 
 Correctness is validated in interpret mode on CPU (this file's main());
-whether it beats the sort on chip is for tools/ to A/B — if it does,
-it becomes a fourth ``compaction=`` lowering.
+the kernel ships as ``spawn_xla(compaction="pallas")``, opt-in until
+this A/B proves it on chip.
 """
 
 from __future__ import annotations
@@ -45,7 +46,6 @@ import numpy as np
 
 
 from stateright_tpu.ops.pallas_compact import (  # noqa: E402
-    compact_pallas,
     compact_pallas_staged,
 )
 
@@ -88,16 +88,8 @@ def main() -> None:
     P, M, cap, B = 8, 1 << 14, 1 << 13, 512
     mask_np = rng.integers(0, 5, M) == 0  # ~20% density, under cap
     planes_np = rng.integers(0, 2**32, (P, M), dtype=np.uint32)
-    out = compact_pallas(
-        jnp.asarray(mask_np), jnp.asarray(planes_np), cap, block=B,
-        interpret=interpret,
-    )
     n = int(mask_np.sum())
     want = planes_np[:, mask_np]
-    got = np.asarray(out)[:, :n]
-    assert np.array_equal(got, want), "MISMATCH"
-    print(f"pallas compact OK: {n} survivors of {M}, P={P}, interpret={interpret}")
-
     out_s = compact_pallas_staged(
         jnp.asarray(mask_np), jnp.asarray(planes_np), cap, block=B,
         interpret=interpret,
@@ -117,8 +109,6 @@ def main() -> None:
         mask = jnp.asarray(mask_np)
         planes = jnp.asarray(planes_np)
 
-        # compact_pallas is a delegate of the staged kernel since the
-        # r5e rework — one row per distinct compiled program.
         f_stg = jax.jit(functools.partial(compact_pallas_staged, cap=cap, block=B))
         f_sort = jax.jit(functools.partial(_sort_compact, cap=cap))
         for name, fn in (("staged", f_stg), ("sort", f_sort)):
