@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# Round-5e tunnel watcher — v3, replacing tools/tpu_watch_r5d.sh after
+# the 06:12 window taught three things:
+#   * the delta structure STILL faults the TPU runtime post-redesign
+#     (registry #4 status note) — benching it is a guaranteed ~15-min
+#     crash loop per pass, so the delta/stack benches are DROPPED and
+#     `tools/delta_diag.py` (the standalone program bisector) runs
+#     instead: one window of diag beats five windows of crashes;
+#   * the pallas kernel was rebuilt for Mosaic (no cumsum, no
+#     dynamic-offset vector stores — registry #6); the probe + the
+#     pallas bench are the decisive first-silicon rows;
+#   * bench.py + spawn_xla now resolve planes-only compaction requests
+#     sanely on the CPU fallback, so a dead tunnel no longer turns the
+#     pallas stage into a crash.
+# Markers are SHARED with v2 (.r5d_markers/) so a stage an earlier
+# window finished stays finished.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_watch_r5e.log
+MARK=.r5d_markers
+mkdir -p "$MARK"
+log() { echo "[watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+probe() { timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; }
+commit_stage() {
+  local msg=$1 f; shift
+  for f in "$@" "$LOG"; do
+    git add -f -- "$f" >>"$LOG" 2>&1 || log "artifact missing: $f"
+  done
+  git commit -q -m "$msg" >>"$LOG" 2>&1 && log "committed: $msg"
+}
+done_p() { [ -f "$MARK/$1" ]; }
+mark() { touch "$MARK/$1"; }
+
+# run_tool NAME TIMEOUT LOGFILE CMD... — marker on rc==0 (the axon
+# platform is pinned by sitecustomize, so a tool that ran to rc==0 ran
+# on the chip; a wedge times out and leaves no marker).
+run_tool() {
+  local name=$1 tmo=$2 out=$3; shift 3
+  done_p "$name" && { log "skip $name (done)"; return 0; }
+  probe || { log "tunnel down before $name; back to wait"; return 1; }
+  log "stage $name: $*"
+  timeout "$tmo" "$@" >"$out" 2>&1
+  local rc=$?
+  log "$name rc=$rc: $(tail -c 250 "$out" 2>/dev/null)"
+  [ $rc -eq 0 ] && mark "$name"
+  commit_stage "TPU r5e $name (rc=$rc)" "$out"
+  return 0
+}
+
+# run_bench NAME TIMEOUT OUTJSON ENV... — marker needs rc==0 AND a tpu
+# JSON line (bench.py silently falls back to a cpu worker otherwise).
+run_bench() {
+  local name=$1 tmo=$2 out=$3; shift 3
+  done_p "$name" && { log "skip $name (done)"; return 0; }
+  probe || { log "tunnel down before $name; back to wait"; return 1; }
+  log "stage $name: bench.py $*"
+  timeout "$tmo" env "$@" python bench.py >"$out" 2>>"$LOG"
+  local rc=$?
+  log "$name rc=$rc: $(tail -c 300 "$out" 2>/dev/null)"
+  if [ $rc -eq 0 ] && grep -q 'spawn_xla, tpu' "$out"; then mark "$name"; fi
+  commit_stage "TPU r5e $name (rc=$rc)" "$out" bench_detail.json bench_probe.log
+  return 0
+}
+
+log "watcher v3 started (pid $$)"
+while true; do
+  if probe; then
+    log "TUNNEL UP — staged pass"
+    # 0. pallas synthetic probe — the reworked kernel's first silicon
+    run_tool pallas_probe2 1500 tpu_pallas_compact2.log \
+      python tools/pallas_compact.py || { sleep 240; continue; }
+    # 1. pallas bench (headline config, no matrix)
+    run_bench bench_pallas2 2400 bench_r5e_pallas.json \
+      STPU_COMPACTION=pallas BENCH_MATRIX=0 || { sleep 240; continue; }
+    # 2. superstep profile incl. mixed-lowering A/B rows (delta last)
+    run_tool profile 2700 tpu_profile_r5c.log \
+      python tools/profile_superstep.py 8 || { sleep 240; continue; }
+    # 3. sort-dtype A/B (key packing decision)
+    run_tool sortbench 1200 tpu_sortbench.log \
+      python tools/sortbench.py 23 || { sleep 240; continue; }
+    # 4. engine-level packed-keys A/B
+    run_tool packed_ab 2400 tpu_packed_ab.log \
+      python tools/packed_ab.py 8 || { sleep 240; continue; }
+    # 5. delta-fault bisect: standalone programs across the shape ladder
+    run_tool delta_diag 2400 tpu_delta_diag.log \
+      python tools/delta_diag.py 22 || { sleep 240; continue; }
+    # 6. scale soak rm=10/11 + paxos 3c/3s, sorted structure only (the
+    #    delta retries are pointless until the diag localizes the fault)
+    run_tool soak 7200 tpu_soak_r5e.log \
+      python tools/tpu_soak.py --skip-rm9 --no-delta-retry || { sleep 240; continue; }
+    if done_p pallas_probe2 && done_p bench_pallas2 && done_p profile \
+       && done_p sortbench && done_p packed_ab && done_p delta_diag \
+       && done_p soak; then
+      log "all stages done; watcher exiting"
+      exit 0
+    fi
+    log "pass finished with unfinished stages; resuming watch"
+  else
+    log "tunnel down"
+  fi
+  sleep 240
+done
