@@ -69,54 +69,93 @@ def _as_lanes(planes):
     return list(planes)
 
 
-def _ring_update(mask_ref, plane_refs, stage, p, B: int):
-    """Shared block body: fold this block's survivors into the [P, 2B]
-    VMEM ring at running offset ``p`` via the ring-targeted one-hot
-    contraction (module docstring steps 1–3). Returns ``n_b``, the
-    block's survivor count. Survivors whose target position would fall
-    at or past 2B (flush frozen at the cap) match no column and are
-    dropped without any out-of-bounds access."""
+def tri_inclusive(m_i32, B: int):
+    """Inclusive prefix sum of a 0/1 [B] vector as the lower-triangular
+    MXU contraction — Mosaic has no cumsum lowering inside TC kernels
+    (registry #6). 0/1 operands with <= B-term f32 accumulation are
+    exact at HIGHEST at any plausible block size."""
     import jax
     import jax.numpy as jnp
 
-    P = len(plane_refs)
-    m = mask_ref[:].astype(jnp.int32)
-    # Inclusive prefix sum as a lower-triangular [B, B] contraction:
-    # Mosaic has no cumsum lowering inside TC kernels (registry #6).
     ii = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
     jj = jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
     tri = (ii >= jj).astype(jnp.float32)
-    incl = jax.lax.dot_general(
-        tri,
-        m.astype(jnp.float32).reshape(B, 1),
-        (((1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
-    ).reshape(B).astype(jnp.int32)
-    n_b = jnp.sum(m)
-    # Ring target of each survivor; non-survivors aim at -1 (no column).
-    tgt = jnp.where(m > 0, incl - 1 + p, -1)
-    jr = jax.lax.broadcasted_iota(jnp.int32, (B, 2 * B), 1)
-    sel = (jr == tgt.reshape(B, 1)).astype(jnp.float32)
-    blk = jnp.stack([r[:] for r in plane_refs])  # [P, B], VMEM-local
-    # Mosaic has no direct u32<->f32 cast; both halves are <= 0xFFFF so
-    # the i32 hop is value-exact in each direction (registry #6).
-    lo16 = (blk & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
-    hi16 = (blk >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
+    return (
+        jax.lax.dot_general(
+            tri,
+            m_i32.astype(jnp.float32).reshape(B, 1),
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        .reshape(B)
+        .astype(jnp.int32)
+    )
+
+
+def split16(u32, jnp):
+    """u32 -> (lo16, hi16) as f32 via the i32 hop (no direct u32<->f32
+    cast on Mosaic; both halves <= 0xFFFF are value-exact — registry
+    #6). The exactness-critical half of the scatter-as-matmul trick:
+    16-bit-valued f32s survive a HIGHEST-precision contraction exactly,
+    where the default bf16 pass would truncate them."""
+    lo = (u32 & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
+    hi = (u32 >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
+    return lo, hi
+
+
+def fuse16(lo_f32, hi_f32, jnp):
+    """Inverse of :func:`split16` after an exact contraction."""
+    return lo_f32.astype(jnp.int32).astype(jnp.uint32) | (
+        hi_f32.astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
+    )
+
+
+def ring_fold(stage, arrays, tgt, B: int):
+    """Fold u32 source lanes into a [P, 2B] VMEM ring: lane s of every
+    array lands at ring position ``tgt[s]`` (-1 or >= 2B = dropped —
+    the flush-frozen overflow path is drop-safe by construction, no
+    out-of-bounds access exists). The scatter-as-matmul core shared by
+    pallas_compact and pallas_merge: a [S, 2B] one-hot contraction of
+    the 16-bit halves at ``Precision.HIGHEST`` — each output column
+    sums at most ONE nonzero product of 16-bit-valued f32s, so the
+    result is exact; the default bf16 MXU pass would silently truncate
+    the u16 halves (8-bit mantissa) — the precision pin is
+    load-bearing. Mosaic has no direct u32<->f32 cast; the i32 hop is
+    value-exact for the <= 0xFFFF halves (registry #6)."""
+    import jax
+    import jax.numpy as jnp
+
+    P = len(arrays)
+    S = tgt.shape[0]
+    jr = jax.lax.broadcasted_iota(jnp.int32, (S, 2 * B), 1)
+    sel = (jr == tgt.reshape(S, 1)).astype(jnp.float32)
+    blk = jnp.stack(list(arrays))  # [P, S]
+    lo16, hi16 = split16(blk, jnp)
     contrib = jax.lax.dot_general(
-        jnp.concatenate([lo16, hi16], axis=0),  # [2P, B]
-        sel,  # [B, 2B]
+        jnp.concatenate([lo16, hi16], axis=0),  # [2P, S]
+        sel,  # [S, 2B]
         (((1,), (0,)), ((), ())),
-        # Exactness pin — see the module docstring. DEFAULT would run a
-        # single bf16 pass and truncate the 16-bit payload halves.
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )  # [2P, 2B]
-    packed = contrib[:P].astype(jnp.int32).astype(jnp.uint32) | (
-        contrib[P:].astype(jnp.int32).astype(jnp.uint32) << jnp.uint32(16)
-    )
+    packed = fuse16(contrib[:P], contrib[P:], jnp)
     hit = jnp.sum(sel, axis=0, keepdims=True) > 0.5  # [1, 2B]
     stage[:, :] = jnp.where(hit, packed, stage[:, :])
+
+
+def _ring_update(mask_ref, plane_refs, stage, p, B: int):
+    """Block body: fold this block's mask-selected survivors into the
+    ring at running offset ``p`` (compaction targets = local rank + p).
+    Returns ``n_b``, the block's survivor count."""
+    import jax
+    import jax.numpy as jnp
+
+    m = mask_ref[:].astype(jnp.int32)
+    incl = tri_inclusive(m, B)
+    n_b = jnp.sum(m)
+    tgt = jnp.where(m > 0, incl - 1 + p, -1)
+    ring_fold(stage, [r[:] for r in plane_refs], tgt, B)
     return n_b
 
 

@@ -61,6 +61,14 @@ VALUES_VIA = os.environ.get("STPU_SORTEDSET_VALUES", "auto")
 #: (differential-tested). Trace-time constant like VALUES_VIA.
 KEYS_VIA = os.environ.get("STPU_SORTEDSET_KEYS", "pair")
 
+#: Insert lowering: ``"sort"`` = the two table-scale multi-operand
+#: ``lax.sort``s below; ``"pallas"`` = the O(C+m) streaming merge
+#: kernel (``ops/pallas_merge.py``) — the table-scale log^2 term
+#: disappears and every remaining sort is batch-scale. Opt-in pending
+#: the chip A/B (tools/pallas_merge.py); CPU runs the kernel in
+#: interpret mode (slow, exact). Trace-time constant like VALUES_VIA.
+INSERT_VIA = os.environ.get("STPU_SORTEDSET_INSERT", "sort")
+
 
 def _via_sort() -> bool:
     if VALUES_VIA == "auto":
@@ -165,6 +173,14 @@ def insert(
     m = fp_hi.shape[0]
     full = jnp.uint32(0xFFFFFFFF)
 
+    if INSERT_VIA == "pallas":
+        blk = int(os.environ.get("STPU_PALLAS_BLOCK", "512"))
+        if cap % blk == 0 and m % blk == 0 and cap >= blk and m >= blk:
+            return _insert_via_merge(ss, fp_hi, fp_lo, val_hi, val_lo,
+                                     active, blk)
+        # Shapes below the kernel block fall through to the sort
+        # lowering, bit-identically (same convention as compact_1d).
+
     # Pad rows (unoccupied visited slots, inactive candidates) get the
     # reserved all-ones key so they sort to the tail as one run.
     vis_valid = jnp.arange(cap) < ss.n
@@ -264,6 +280,65 @@ def insert(
         is_new = jnp.zeros((m,), jnp.bool_).at[idx].set(True, mode="drop")
 
     return SortedSet(nkh, nkl, nvh, nvl, jnp.minimum(new_n, cap)), is_new, overflow
+
+
+def _insert_via_merge(ss, fp_hi, fp_lo, val_hi, val_lo, active, blk):
+    """``insert`` by the O(C+m) pallas streaming merge
+    (ops/pallas_merge.py): one BATCH-scale presort, the kernel, one
+    batch-scale inverse sort — no table-scale sort anywhere. Returns
+    the identical contract, bit-for-bit (pinned by
+    tests/test_pallas_merge.py's engine differential)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .pallas_merge import merge_insert
+
+    cap = ss.capacity
+    m = fp_hi.shape[0]
+    full = jnp.uint32(0xFFFFFFFF)
+
+    # Batch presort by (key, ticket): lowest batch index first within
+    # equal keys, so the kernel's keep-first rule elects the reference
+    # winner. Inactive rows get the all-ones key (never real).
+    kh = jnp.where(active, fp_hi, full)
+    kl = jnp.where(active, fp_lo, full)
+    ticket = jnp.arange(m, dtype=jnp.int32)
+    skh, skl, st, svh, svl = jax.lax.sort(
+        (kh, kl, ticket, val_hi, val_lo), num_keys=3
+    )
+
+    vis_valid = jnp.arange(cap) < ss.n
+    table = jnp.stack(
+        [
+            jnp.where(vis_valid, ss.key_hi, full),
+            jnp.where(vis_valid, ss.key_lo, full),
+            ss.val_hi,
+            ss.val_lo,
+        ]
+    )
+    batch = jnp.stack([skh, skl, svh, svl])
+    interp = jax.default_backend() == "cpu"
+    merged, keep_sorted, n_keep = merge_insert(
+        table, batch, block=blk, interpret=interp
+    )
+
+    overflow = n_keep > cap
+    new_n = jnp.minimum(n_keep, cap)
+    row_ok = jnp.arange(cap) < new_n
+    z = jnp.uint32(0)
+    out = SortedSet(
+        jnp.where(row_ok, merged[0], z),
+        jnp.where(row_ok, merged[1], z),
+        jnp.where(row_ok, merged[2], z),
+        jnp.where(row_ok, merged[3], z),
+        new_n,
+    )
+    # is_new back to batch order: sorting (ticket, flag) by ticket is
+    # the inverse permutation — batch-scale, scatter-free.
+    _, in_order = jax.lax.sort(
+        (st, keep_sorted.astype(jnp.int32)), num_keys=1
+    )
+    return out, in_order.astype(jnp.bool_), overflow
 
 
 def lookup(ss: SortedSet, fp_hi, fp_lo, *, max_probes: int = 0):

@@ -1,0 +1,211 @@
+"""Exactness pins for the pallas streaming merge-insert
+(ops/pallas_merge.py) in interpret mode — the CPU reference semantics
+for the chip program (same contract as tests/test_pallas_compact.py).
+
+Reference semantics: sortedset.insert's dedup rule
+(/root/reference/src/checker/bfs.rs:247-259's visited-set insert,
+generalized) — existing rows win over equal-key candidates, the lowest
+batch index wins among in-batch duplicates, winners' values stored.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from stateright_tpu.ops.pallas_merge import merge_insert
+
+FULL = 0xFFFFFFFF
+B, C, M = 256, 1024, 512
+
+
+def _mk(rng, n_table, n_cand, key_space):
+    tk = np.sort(rng.choice(key_space, n_table, replace=False)).astype(np.uint64)
+    table = np.full((4, C), FULL, np.uint32)
+    table[0, :n_table] = (tk >> 16).astype(np.uint32)
+    table[1, :n_table] = (tk & 0xFFFF).astype(np.uint32)
+    table[2, :n_table] = rng.integers(0, 2**32, n_table, dtype=np.uint32)
+    table[3, :n_table] = rng.integers(0, 2**32, n_table, dtype=np.uint32)
+    ck = rng.choice(key_space, n_cand, replace=True).astype(np.uint64)
+    order = np.argsort(ck, kind="stable")
+    batch = np.full((4, M), FULL, np.uint32)
+    batch[0, :n_cand] = (ck >> 16).astype(np.uint32)[order]
+    batch[1, :n_cand] = (ck & 0xFFFF).astype(np.uint32)[order]
+    batch[2, :n_cand] = rng.integers(0, 2**32, n_cand, dtype=np.uint32)
+    batch[3, :n_cand] = rng.integers(0, 2**32, n_cand, dtype=np.uint32)
+    return table, batch
+
+
+def _reference(table, batch, n_t, n_c):
+    tkeys = (table[0, :n_t].astype(np.uint64) << 32) | table[1, :n_t]
+    bkeys = (batch[0, :n_c].astype(np.uint64) << 32) | batch[1, :n_c]
+    seen = set(tkeys.tolist())
+    want_keep = np.zeros(M, bool)
+    new = []
+    for i in range(n_c):
+        if int(bkeys[i]) not in seen:
+            seen.add(int(bkeys[i]))
+            want_keep[i] = True
+            new.append((bkeys[i], batch[2, i], batch[3, i]))
+    allk = np.concatenate(
+        [tkeys, np.array([r[0] for r in new], np.uint64)]
+    ) if new else tkeys
+    vh = np.concatenate(
+        [table[2, :n_t], np.array([r[1] for r in new], np.uint32)]
+    ) if new else table[2, :n_t]
+    vl = np.concatenate(
+        [table[3, :n_t], np.array([r[2] for r in new], np.uint32)]
+    ) if new else table[3, :n_t]
+    o = np.argsort(allk, kind="stable")
+    return want_keep, n_t + len(new), allk[o], vh[o], vl[o]
+
+
+def _run(table, batch):
+    mg, kb, nk = merge_insert(
+        jnp.asarray(table), jnp.asarray(batch), block=B, interpret=True
+    )
+    return np.asarray(mg), np.asarray(kb), int(nk)
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_randomized_matches_reference(trial):
+    rng = np.random.default_rng(100 + trial)
+    n_t = int(rng.integers(0, 900))
+    n_c = int(rng.integers(0, 500))
+    ks = rng.choice(2**20, 2000, replace=False)
+    table, batch = _mk(rng, n_t, n_c, ks)
+    mg, kb, nk = _run(table, batch)
+    want_keep, want_n, wk, wvh, wvl = _reference(table, batch, n_t, n_c)
+    assert nk == want_n
+    assert np.array_equal(kb, want_keep)
+    gk = (mg[0, :want_n].astype(np.uint64) << 32) | mg[1, :want_n]
+    assert np.array_equal(gk, wk)
+    assert np.array_equal(mg[2, :want_n], wvh)
+    assert np.array_equal(mg[3, :want_n], wvl)
+
+
+def test_overflow_reports_total_and_flags_survive():
+    rng = np.random.default_rng(3)
+    tk = np.sort(rng.choice(2**20, 400, replace=False)).astype(np.uint64)
+    ck = np.sort(np.setdiff1d(
+        rng.choice(2**20, 400, replace=False).astype(np.uint64), tk
+    )[:200])
+    Cs = 512
+    table = np.full((4, Cs), FULL, np.uint32)
+    batch = np.full((4, M), FULL, np.uint32)
+    table[0, :400] = (tk >> 16).astype(np.uint32)
+    table[1, :400] = (tk & 0xFFFF).astype(np.uint32)
+    batch[0, :200] = (ck >> 16).astype(np.uint32)
+    batch[1, :200] = (ck & 0xFFFF).astype(np.uint32)
+    mg, kb, nk = merge_insert(
+        jnp.asarray(table), jnp.asarray(batch), block=B, interpret=True
+    )
+    assert int(nk) == 600 > Cs  # caller's grow-and-retry signal
+    kb = np.asarray(kb)
+    assert kb[:200].all() and not kb[200:].any()
+
+
+def test_insert_via_merge_matches_sort_lowering(monkeypatch):
+    """sortedset.insert under STPU_SORTEDSET_INSERT=pallas is
+    bit-identical to the sort lowering: table planes, n, is_new (batch
+    order), overflow."""
+    from stateright_tpu.ops import sortedset
+
+    rng = np.random.default_rng(11)
+    cap, m = 512, 256
+    monkeypatch.setenv("STPU_PALLAS_BLOCK", "64")
+    for trial in range(3):
+        n0 = int(rng.integers(0, cap // 2))
+        keys = rng.choice(2**18, n0 + m, replace=False).astype(np.uint64)
+        ss = sortedset.from_entries(
+            jnp.asarray((keys[:n0] >> 8).astype(np.uint32)),
+            jnp.asarray((keys[:n0] & 0xFF).astype(np.uint32)),
+            jnp.asarray(rng.integers(0, 2**32, n0, dtype=np.uint32)),
+            jnp.asarray(rng.integers(0, 2**32, n0, dtype=np.uint32)),
+            cap,
+            jnp,
+        )
+        # Batch: half fresh keys, half dups of table keys, some inactive.
+        pick = rng.integers(0, n0 + m, m)
+        bh = jnp.asarray((keys[pick] >> 8).astype(np.uint32))
+        bl = jnp.asarray((keys[pick] & 0xFF).astype(np.uint32))
+        vh = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+        vl = jnp.asarray(rng.integers(0, 2**32, m, dtype=np.uint32))
+        act = jnp.asarray(rng.integers(0, 4, m) > 0)
+
+        monkeypatch.setattr(sortedset, "INSERT_VIA", "sort")
+        ss_a, new_a, ovf_a = sortedset.insert(ss, bh, bl, vh, vl, act)
+        monkeypatch.setattr(sortedset, "INSERT_VIA", "pallas")
+        ss_b, new_b, ovf_b = sortedset.insert(ss, bh, bl, vh, vl, act)
+
+        assert int(ss_a.n) == int(ss_b.n), trial
+        assert bool(ovf_a) == bool(ovf_b), trial
+        assert np.array_equal(np.asarray(new_a), np.asarray(new_b)), trial
+        for fa, fb in zip(ss_a[:4], ss_b[:4]):
+            assert np.array_equal(np.asarray(fa), np.asarray(fb)), trial
+
+        # Non-block-divisible batch falls through to the sort lowering
+        # bit-identically (the gate's documented convention).
+        odd = m - 56
+        ss_c, new_c, ovf_c = sortedset.insert(
+            ss, bh[:odd], bl[:odd], vh[:odd], vl[:odd], act[:odd]
+        )
+        monkeypatch.setattr(sortedset, "INSERT_VIA", "sort")
+        ss_d, new_d, ovf_d = sortedset.insert(
+            ss, bh[:odd], bl[:odd], vh[:odd], vl[:odd], act[:odd]
+        )
+        assert int(ss_c.n) == int(ss_d.n), trial
+        assert np.array_equal(np.asarray(new_c), np.asarray(new_d)), trial
+
+
+def test_engine_via_merge_matches(monkeypatch):
+    """Full-engine differential: counts AND witness paths equal under
+    the merge-insert lowering (same contract as the compaction modes,
+    tests/test_sortedset.py)."""
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+    from stateright_tpu.ops import sortedset
+
+    kw = dict(frontier_capacity=1 << 7, table_capacity=1 << 9, dedup="sorted")
+    a = PackedTwoPhaseSys(3).checker().spawn_xla(**kw).join()
+    da = a.discoveries()
+    assert da
+    monkeypatch.setenv("STPU_PALLAS_BLOCK", "64")
+    monkeypatch.setattr(sortedset, "INSERT_VIA", "pallas")
+    b = PackedTwoPhaseSys(3).checker().spawn_xla(**kw).join()
+    assert (a.state_count(), a.unique_state_count()) == (
+        b.state_count(),
+        b.unique_state_count(),
+    )
+    db = b.discoveries()
+    assert set(da) == set(db)
+    for name in da:
+        assert da[name].into_states() == db[name].into_states()
+
+
+def test_edge_empty_and_dup_runs():
+    rng = np.random.default_rng(5)
+    tk = np.sort(rng.choice(2**20, 300, replace=False)).astype(np.uint64)
+    table = np.full((4, C), FULL, np.uint32)
+    table[0, :300] = (tk >> 16).astype(np.uint32)
+    table[1, :300] = (tk & 0xFFFF).astype(np.uint32)
+    empty_b = np.full((4, M), FULL, np.uint32)
+    _, kb, nk = _run(table, empty_b)
+    assert nk == 300 and not kb.any()
+    empty_t = np.full((4, C), FULL, np.uint32)
+    _, kb, nk = _run(empty_t, empty_b)
+    assert nk == 0 and not kb.any()
+    # One absent key repeated across a block boundary: single winner,
+    # lowest batch index (its value), exercises the SMEM key-carry.
+    batch = np.full((4, M), FULL, np.uint32)
+    batch[0, :300] = 5
+    batch[1, :300] = 9
+    batch[2, :300] = np.arange(300, dtype=np.uint32)
+    mg, kb, nk = _run(table, batch)
+    assert nk == 301
+    assert kb[0] and not kb[1:].any()
+    keys = (mg[0, :301].astype(np.uint64) << 32) | mg[1, :301]
+    pos = int(np.searchsorted(keys, (np.uint64(5) << np.uint64(32)) | np.uint64(9)))
+    assert mg[2, pos] == 0
