@@ -69,6 +69,10 @@ while true; do
     # 0. pallas synthetic probe — the reworked kernel's first silicon
     run_tool pallas_probe2 1500 tpu_pallas_compact2.log \
       python tools/pallas_compact.py || { sleep 240; continue; }
+    # 0b. merge-insert probe: correctness vs the sort core, then the
+    #     O(C+m)-vs-sort A/B; answers the arbitrary-offset-DMA question
+    run_tool merge_probe 1800 tpu_pallas_merge.log \
+      python tools/pallas_merge.py || { sleep 240; continue; }
     # 1. pallas bench (headline config, no matrix)
     run_bench bench_pallas2 2400 bench_r5e_pallas.json \
       STPU_COMPACTION=pallas BENCH_MATRIX=0 || { sleep 240; continue; }
