@@ -92,10 +92,19 @@ class CheckerBuilder:
         Requires the model to implement the :class:`PackedModel` protocol
         (see ``stateright_tpu.xla`` for the contract).
 
+        Engine-tuning knobs ride through ``kwargs`` to ``XlaChecker``:
+        ``dedup=``, ``compaction=``, ``ladder=``, ``shrink_exit=``, and
+        ``cand_ladder=`` (the in-program candidate-width ladder: fused
+        dispatches branch over up to K=3 sub-width supersteps via
+        ``lax.switch``, so narrow levels sort snug candidate buffers with
+        zero added host round-trips; ``STPU_CAND_LADDER`` is the env
+        form, 1 disables, planes engine only).
+
         With ``mesh`` (a ``jax.sharding.Mesh`` with one axis, more than one
         device), the frontier and visited set shard by fingerprint ownership
         over the mesh with all-to-all routing per super-step
-        (``stateright_tpu.parallel``).
+        (``stateright_tpu.parallel``; the single-chip tuning knobs above
+        do not apply there).
         """
         try:
             from ..xla import XlaChecker

@@ -356,6 +356,22 @@ def maintain_jit(ds: DeltaSet) -> Tuple[DeltaSet, "jax.Array"]:
     return _maintain_jitted(ds)
 
 
+def insert_lane_words(ds: DeltaSet, m: int) -> int:
+    """32-bit words carried as ``lax.sort`` operands by one :func:`insert`
+    with an ``m``-lane batch (the cost-law telemetry; see
+    ``sortedset.insert_lane_words``). The table-scale flush
+    (:func:`maintain`) is host-invoked and amortized, so it is not a
+    per-level term. Membership is bsearch gathers — no sorted lanes."""
+    from .sortedset import _via_sort
+
+    Dc = ds.delta_capacity
+    # Prologue: 5-word sort (keys+ticket+values as payload, packed or
+    # pair) or 3-word gather-family sort; inverse permutation 2 words;
+    # delta merge 4 words (2 key + 2 value, packed or pair).
+    prologue = m * (5 if _via_sort() else 3)
+    return prologue + m * 2 + (Dc + m) * 4
+
+
 def lookup(ds: DeltaSet, fp_hi, fp_lo, *, max_probes: int = 0):
     """Batched membership + value lookup across both tiers."""
     import jax.numpy as jnp

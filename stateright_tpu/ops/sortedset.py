@@ -174,8 +174,8 @@ def insert(
     full = jnp.uint32(0xFFFFFFFF)
 
     if INSERT_VIA == "pallas":
-        blk = int(os.environ.get("STPU_PALLAS_BLOCK", "512"))
-        if cap % blk == 0 and m % blk == 0 and cap >= blk and m >= blk:
+        blk = _pallas_insert_block(cap, m)
+        if blk:
             return _insert_via_merge(ss, fp_hi, fp_lo, val_hi, val_lo,
                                      active, blk)
         # Shapes below the kernel block fall through to the sort
@@ -339,6 +339,39 @@ def _insert_via_merge(ss, fp_hi, fp_lo, val_hi, val_lo, active, blk):
         (st, keep_sorted.astype(jnp.int32)), num_keys=1
     )
     return out, in_order.astype(jnp.bool_), overflow
+
+
+def _pallas_insert_block(cap: int, m: int) -> int:
+    """The streaming-merge kernel block :func:`insert` will use at these
+    shapes, or 0 when they fall through to the sort lowering — ONE
+    predicate shared by the insert and its lane-words telemetry, so the
+    cost law can't silently drift from the actual lowering."""
+    blk = int(os.environ.get("STPU_PALLAS_BLOCK", "512"))
+    if cap % blk == 0 and m % blk == 0 and cap >= blk and m >= blk:
+        return blk
+    return 0
+
+
+def insert_lane_words(ss: SortedSet, m: int) -> int:
+    """32-bit words carried as ``lax.sort`` operands by one :func:`insert`
+    with an ``m``-lane batch at this table's capacity — the engine's
+    cost-law telemetry (round-5 law: per-level time ~ sorted lane-words
+    x log^2 n). Counts sort operands only; post-sort gathers and the
+    scatter ``is_new`` route are not sorted lanes. Tracks the same
+    trace-time lowering knobs the insert resolves."""
+    cap = ss.capacity
+    if INSERT_VIA == "pallas" and _pallas_insert_block(cap, m):
+        # Batch-scale only: 5-operand presort + 2-operand inverse.
+        return m * 7
+    n = cap + m
+    if _via_sort():
+        # Packed or pair, the sorted WORDS agree (packed trades operand
+        # streams, not bytes): 5-word merge + 5-word compaction + 2-word
+        # inverse permutation.
+        return n * 12
+    # Gather family: 3-operand merge + 2-operand compaction argsort;
+    # values and is_new move by gather/scatter.
+    return n * 5
 
 
 def lookup(ss: SortedSet, fp_hi, fp_lo, *, max_probes: int = 0):

@@ -143,6 +143,7 @@ class XlaChecker(Checker):
         compaction: str = "auto",
         ladder: str = "auto",
         shrink_exit: str = "auto",
+        cand_ladder: Any = "auto",
     ):
         import jax
 
@@ -293,6 +294,64 @@ class XlaChecker(Checker):
                 f"shrink_exit must be 'auto', 'on', or 'off': {shrink_exit!r}"
             )
         self._shrink_exit = shrink_exit == "on"
+        # In-program candidate-width ladder (attack #2 of the BASELINE
+        # roadmap, delivered IN-PROGRAM per the shrink-exit chip lesson:
+        # any scheme that adds host dispatches to the tail pays ~150 ms
+        # per round-trip over the tunnel, so snug candidate sorts must
+        # ride inside the fused ``lax.while_loop``). Fused dispatches
+        # branch via ``lax.switch`` over up to K sub-width supersteps —
+        # each rung is the (frontier rows, candidate cap) shape a smaller
+        # host bucket would run, specialised into the peak program — so a
+        # narrow level's candidate-scale sorts (the [table ‖ cand] insert
+        # merge, the frontier compaction) and its grid-scale compaction
+        # all run snug with ZERO added host round-trips. Branch selection
+        # is on-device (see _build_fused); an underestimate falls through
+        # to the full-width branch in-program, never dropping candidates.
+        # "auto" = STPU_CAND_LADDER or 3 (on for CPU and accelerators —
+        # the savings are in-program, so there is no RTT trade); 1
+        # disables (one branch = the pre-ladder program, byte-for-byte).
+        # Each rung is a full superstep trace, so K bounds the fused
+        # program's compile cost (~11 s/bucket baseline on 1-core CPU,
+        # ROUND5.md item 6). Planes engine only: the rows/hash superstep
+        # has no candidate-scale sorts to snug.
+        explicit_cand_ladder = cand_ladder != "auto"
+        env_cand_ladder = bool(os.environ.get("STPU_CAND_LADDER"))
+        if cand_ladder == "auto":
+            cand_ladder = os.environ.get("STPU_CAND_LADDER") or "3"
+        try:
+            ladder_k = int(cand_ladder)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"cand_ladder must be 'auto' or an int in 1..3: {cand_ladder!r}"
+            ) from None
+        if not 1 <= ladder_k <= 3:
+            raise ValueError(f"cand_ladder must be in 1..3: {ladder_k}")
+        if ladder_k > 1 and not self._soa:
+            if explicit_cand_ladder:
+                raise ValueError(
+                    "cand_ladder runs in the plane-major engine: pass "
+                    "dedup='sorted' or 'delta' (the hash engine's rows "
+                    "superstep has no candidate-scale sorts to snug)"
+                )
+            if env_cand_ladder:
+                # Only an explicit env A/B request warns; the default
+                # auto→3 resolving to 1 on the hash engine is the normal
+                # CPU configuration, not a misconfiguration.
+                import warnings
+
+                warnings.warn(
+                    "STPU_CAND_LADDER has no effect with dedup='hash' "
+                    "(rows-major superstep); the knob applies to the "
+                    "sorted/delta planes engine only",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+            ladder_k = 1
+        self._cand_ladder_k = ladder_k
+        #: In-program fall-throughs (snug branch overflowed, level re-ran
+        #: at full width inside the same dispatch) — the ladder's only
+        #: waste case, observable for tests and the A/B harness.
+        self.cand_retries = 0
         # Expand-stage layout (attack 2 of the BASELINE roadmap; A/B knob
         # for the chip window). "rows" materializes the [F, A, W] grid the
         # vmap naturally produces, then transposes to [W, A*F] planes —
@@ -372,6 +431,27 @@ class XlaChecker(Checker):
         self._superstep_cache: Dict[Any, Any] = model.__dict__.setdefault(
             "_xla_superstep_cache", {}
         )
+
+        # Candidate-cap sizing is PER-CHECKER state seeded from per-model
+        # hints: the old model-level dict let two live checkers over one
+        # model object resize each other's candidate buffers mid-run
+        # (latent aliasing — a cc_ovf growth in checker A silently changed
+        # checker B's bucket shapes and evicted its compiled programs).
+        # Growths still write back to the model hint dict, so a FRESH
+        # checker (the bench measured pass) inherits learned caps and
+        # replays the warm pass's shapes instead of re-paying cc_ovf
+        # growth compiles.
+        self._cand_caps: Dict[int, int] = dict(
+            model.__dict__.get("_xla_cand_cap_hints", {})
+        )
+        # Live-checker registry (weakrefs): _grow_cand_cap consults it so
+        # a growth in this checker never evicts shared compiled programs
+        # a live sibling still sizes at the old cap.
+        import weakref
+
+        live = model.__dict__.setdefault("_xla_live_checkers", [])
+        live[:] = [r for r in live if r() is not None]
+        live.append(weakref.ref(self))
 
         # Capacities learned by earlier checkers of this model (growth
         # events) — starting there skips the rehash-and-rerun the previous
@@ -807,7 +887,9 @@ class XlaChecker(Checker):
 
         return superstep
 
-    def _build_superstep_planes(self, f_cap: int, cand_cap: int):
+    def _build_superstep_planes(
+        self, f_cap: int, cand_cap: int, out_cap: Optional[int] = None
+    ):
         """The superstep with plane-major (structure-of-arrays) bulk
         buffers: the action grid and the candidate set live as ``[W, M]``
         planes so every sort, gather, and elementwise pass over them runs
@@ -828,9 +910,19 @@ class XlaChecker(Checker):
         so the insert's lowest-index winner election, the stored parents,
         and the next frontier's order all match the rows engine (and the
         host oracle's "for each state, for each action" enumeration)
-        exactly."""
+        exactly.
+
+        ``out_cap`` (default ``f_cap``) sizes the NEXT-frontier buffers
+        independently of the expanded width: a candidate-ladder branch
+        expands only ``f_cap = F_k`` rows but must hand back carry-shaped
+        ``[out_cap, W]`` buffers (the fused loop's full bucket), so
+        survivors compact into ``out_cap`` rows and frontier overflow is
+        measured against it."""
         import jax
         import jax.numpy as jnp
+
+        if out_cap is None:
+            out_cap = f_cap
 
         model = self._model
         symmetry = self._symmetry
@@ -1146,9 +1238,9 @@ class XlaChecker(Checker):
 
             # 6. survivors -> next frontier rows (stable: semantic order).
             (new_frontier, new_ebits), new_count = compact_1d(
-                is_new, f_cap, [ccand, cebits], rows_out=(0,)
+                is_new, out_cap, [ccand, cebits], rows_out=(0,)
             )
-            frontier_overflow = new_count > f_cap
+            frontier_overflow = new_count > out_cap
 
             return (
                 new_frontier,
@@ -1171,7 +1263,7 @@ class XlaChecker(Checker):
         return superstep
 
 
-    def _build_fused(self, f_cap: int, cand_cap: int):
+    def _build_fused(self, f_cap: int, rungs):
         """The level loop as a device program: a ``lax.while_loop`` around
         the superstep that commits one BFS level per iteration and exits on
         (a) the level budget, (b) frontier exhaustion, (c) any overflow —
@@ -1181,11 +1273,73 @@ class XlaChecker(Checker):
         candidate collected for the host to confirm), or (e) a state-count
         target. Exit conditions are evaluated at level granularity, exactly
         like the one-level-per-dispatch path; only the host round-trips
-        differ."""
+        differ.
+
+        ``rungs`` is the in-program candidate ladder (``_cand_rungs``):
+        ascending ``[(F_k, C_k)]`` sub-width shapes, last = the full
+        bucket. With K > 1 each iteration picks a branch ON DEVICE via
+        ``lax.switch`` — every branch is a complete superstep at its own
+        static shapes, returning identical carry-shaped outputs — so a
+        narrow level's grid compaction sorts ``A*F_k`` lanes and its
+        insert merges ``[table ‖ C_k]`` instead of the peak shapes, with
+        zero added host dispatches (the shrink-exit chip lesson,
+        BASELINE.md 2026-08-02). Selection per level:
+
+        - the frontier side is EXACT: branch k needs ``F_k >= f_count``
+          (known before expansion), so no state is ever left unexpanded;
+        - the candidate side uses ``min(f_count*A, margin * prev_gen *
+          clamped_growth)`` — the jump ladder's growth extrapolation run
+          device-side. ``f_count*A`` is an exact bound, so when the full
+          sub-grid fits the rung the choice is safe by construction; the
+          estimate only ever picks a SNUGGER rung than the bound.
+        - an UNDERESTIMATE (the chosen rung's candidate buffer
+          overflows) is never host-visible and never drops candidates:
+          the level is not committed, a carry flag forces the next
+          iteration to the full-width branch, and the identical frontier
+          re-runs — the structural fall-through. Counts stay exact by
+          construction (a committed snug level is bit-identical to the
+          full-width level: same candidate order, same winner election).
+
+        TPU caveat, pinned for the chip A/B: registry #4
+        (docs/backend_pathologies.md) faulted on a ``lax.cond`` carrying
+        a main-capacity sort, and a ladder branch carries the [table ‖
+        cand] merge sort — the TPU-target lowering pre-flights clean
+        (tests/test_cand_ladder.py), but the runtime verdict needs the
+        tunnel (tools/cand_ab.py, staged in the r5e watcher)."""
         import jax
         import jax.numpy as jnp
 
-        superstep = self._build_superstep(f_cap, cand_cap)
+        K = len(rungs)
+        if self._soa:
+            steps = [
+                self._build_superstep_planes(Fk, Ck, out_cap=f_cap)
+                for Fk, Ck in rungs
+            ]
+        else:
+            steps = [self._build_superstep_rows(f_cap, Ck) for _, Ck in rungs]
+
+        def make_branch(step, Fk):
+            if Fk == f_cap:
+                return step
+
+            def branch(frontier, f_ebits, f_count, table, disc_found, disc_fp):
+                # Static prefix slice: selection guarantees
+                # f_count <= F_k, so rows beyond the slice are pads.
+                return step(
+                    jax.lax.slice_in_dim(frontier, 0, Fk),
+                    jax.lax.slice_in_dim(f_ebits, 0, Fk),
+                    f_count,
+                    table,
+                    disc_found,
+                    disc_fp,
+                )
+
+            return branch
+
+        branches = [make_branch(s, Fk) for s, (Fk, _) in zip(steps, rungs)]
+        A = self._A
+        growth_clamp = self.LADDER_GROWTH_CLAMP
+        cand_margin = self.CAND_EST_MARGIN
         W = self._W
         n_hv = len(self._hv_idx)
         hv_cap = self._hv_cap
@@ -1198,7 +1352,11 @@ class XlaChecker(Checker):
         L = self._levels_per_dispatch
 
         def fused(frontier, f_ebits, f_count, table, disc_found, disc_fp,
-                  budget, remaining, host_found, shrink_below):
+                  budget, remaining, host_found, shrink_below,
+                  prev_gen0, prev2_gen0):
+            F_rungs = jnp.asarray([r[0] for r in rungs], jnp.int32)
+            C_rungs = jnp.asarray([r[1] for r in rungs], jnp.int32)
+
             def resolved(disc_found, hv_cnt_acc):
                 if P == 0:
                     return jnp.bool_(False)
@@ -1222,11 +1380,18 @@ class XlaChecker(Checker):
                 return jnp.any(jnp.stack(flags))
 
             def cond(carry):
-                (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
+                (committed, frontier, f_ebits, f_count, table, disc_found,
                  disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c,
-                 lvl_frontier, lvl_states, lvl_unique) = carry
+                 lvl_frontier, lvl_states, lvl_unique, lvl_bucket, lvl_cand,
+                 prev_gen, prev2_gen, force_full, retries) = carry
+                # The budget bounds COMMITTED levels (the block's semantic
+                # unit): a ladder fall-through retry is a non-committing
+                # iteration that must not shrink the block the host asked
+                # for. Total iterations stay bounded — every non-commit
+                # either sets an overflow flag (exit) or force_full, and a
+                # forced full-width level commits or overflows.
                 return (
-                    (lvl < budget)
+                    (committed < budget)
                     & (f_count > 0)
                     # Shrink-exit: once the frontier collapses below the
                     # host-chosen threshold (derived from smaller buckets
@@ -1247,15 +1412,55 @@ class XlaChecker(Checker):
                 )
 
             def body(carry):
-                (lvl, committed, frontier, f_ebits, f_count, table, disc_found,
+                (committed, frontier, f_ebits, f_count, table, disc_found,
                  disc_fp, tot_states, tot_unique, ovf, hv_w, hv_f, hv_c,
-                 lvl_frontier, lvl_states, lvl_unique) = carry
+                 lvl_frontier, lvl_states, lvl_unique, lvl_bucket, lvl_cand,
+                 prev_gen, prev2_gen, force_full, retries) = carry
+                hv_w0, hv_f0 = hv_w, hv_f
+                if K == 1:
+                    k = jnp.int32(0)
+                    out = branches[0](
+                        frontier, f_ebits, f_count, table, disc_found, disc_fp
+                    )
+                else:
+                    # Branch selection. ``bound`` is the exact candidate
+                    # ceiling (every grid slot valid); the extrapolated
+                    # estimate may pick a snugger rung, and the frontier
+                    # constraint F_k >= f_count is always exact.
+                    bound = f_count * jnp.int32(A)
+                    growth = jnp.clip(
+                        prev_gen.astype(jnp.float32)
+                        / jnp.maximum(prev2_gen, 1).astype(jnp.float32),
+                        1.0,
+                        growth_clamp,
+                    )
+                    est = prev_gen.astype(jnp.float32) * growth * cand_margin
+                    est_i = jnp.minimum(est, jnp.float32(2**30)).astype(
+                        jnp.int32
+                    )
+                    need = jnp.where(
+                        prev_gen > 0, jnp.minimum(bound, est_i), bound
+                    )
+                    k = jnp.int32(K - 1)
+                    for j in range(K - 2, -1, -1):
+                        ok = (f_count <= F_rungs[j]) & (need <= C_rungs[j])
+                        k = jnp.where(ok, jnp.int32(j), k)
+                    k = jnp.where(force_full, jnp.int32(K - 1), k)
+                    out = jax.lax.switch(
+                        k, branches, frontier, f_ebits, f_count, table,
+                        disc_found, disc_fp,
+                    )
                 (nf, ne, ncount, ntable, ndfound, ndfp, d_states, d_unique,
-                 t_ovf, f_ovf, c_ovf, cc_ovf, lw, lf, lc) = superstep(
-                    frontier, f_ebits, f_count, table, disc_found, disc_fp
-                )
-                any_ovf = t_ovf | f_ovf | c_ovf | cc_ovf
-                commit = ~any_ovf
+                 t_ovf, f_ovf, c_ovf, cc_ovf, lw, lf, lc) = out
+                # A snug branch's candidate overflow is the ladder's
+                # fall-through, not a host event: the level is simply not
+                # committed and the next iteration is forced full-width.
+                # Only the full-width branch's overflow is the real
+                # cc_ovf the host grows on.
+                sub_ovf = cc_ovf & (k < K - 1)
+                real_cc = cc_ovf & (k == K - 1)
+                any_ovf = t_ovf | f_ovf | c_ovf | real_cc
+                commit = ~any_ovf & ~sub_ovf
                 sel = lambda new, old: jax.tree_util.tree_map(
                     lambda a, b: jnp.where(commit, a, b), new, old
                 )
@@ -1266,6 +1471,8 @@ class XlaChecker(Checker):
                 lvl_frontier = lvl_frontier.at[slot].set(f_count, mode="drop")
                 lvl_states = lvl_states.at[slot].set(d_states, mode="drop")
                 lvl_unique = lvl_unique.at[slot].set(d_unique, mode="drop")
+                lvl_bucket = lvl_bucket.at[slot].set(F_rungs[k], mode="drop")
+                lvl_cand = lvl_cand.at[slot].set(C_rungs[k], mode="drop")
                 # Append this level's host-verified candidates to the block
                 # accumulator (frontier order within a level, level order
                 # across the block — the confirmation order the one-level
@@ -1279,10 +1486,9 @@ class XlaChecker(Checker):
                         hv_w = hv_w.at[j].set(hv_w[j].at[tgt].set(lw[j], mode="drop"))
                         hv_f = hv_f.at[j].set(hv_f[j].at[tgt].set(lf[j], mode="drop"))
                     hv_c = sel(hv_c + lc, hv_c)
-                    hv_w = sel(hv_w, carry[11])
-                    hv_f = sel(hv_f, carry[12])
+                    hv_w = sel(hv_w, hv_w0)
+                    hv_f = sel(hv_f, hv_f0)
                 return (
-                    lvl + 1,
                     committed + commit.astype(jnp.int32),
                     sel(nf, frontier),
                     sel(ne, f_ebits),
@@ -1292,17 +1498,26 @@ class XlaChecker(Checker):
                     sel(ndfp, disc_fp),
                     tot_states + jnp.where(commit, d_states, 0),
                     tot_unique + jnp.where(commit, d_unique, 0),
-                    jnp.stack([t_ovf, f_ovf, c_ovf, cc_ovf]),
+                    jnp.stack([t_ovf, f_ovf, c_ovf, real_cc]),
                     hv_w,
                     hv_f,
                     hv_c,
                     lvl_frontier,
                     lvl_states,
                     lvl_unique,
+                    lvl_bucket,
+                    lvl_cand,
+                    jnp.where(commit, d_states, prev_gen),
+                    jnp.where(commit, prev_gen, prev2_gen),
+                    jnp.where(commit, jnp.bool_(False), force_full | sub_ovf),
+                    # Count only fall-throughs that actually re-run
+                    # in-program: a snug cc_ovf coinciding with a REAL
+                    # overflow exits the loop instead (the host resolves
+                    # it and the level re-runs on the next dispatch).
+                    retries + (sub_ovf & ~any_ovf).astype(jnp.int32),
                 )
 
             carry0 = (
-                jnp.int32(0),
                 jnp.int32(0),
                 frontier,
                 f_ebits,
@@ -1319,69 +1534,187 @@ class XlaChecker(Checker):
                 jnp.zeros((L,), jnp.int32),
                 jnp.zeros((L,), jnp.int32),
                 jnp.zeros((L,), jnp.int32),
+                jnp.zeros((L,), jnp.int32),
+                jnp.zeros((L,), jnp.int32),
+                prev_gen0,
+                prev2_gen0,
+                jnp.bool_(False),
+                jnp.int32(0),
             )
-            out = jax.lax.while_loop(cond, body, carry0)
-            return out[1:]  # drop the raw level counter
+            return jax.lax.while_loop(cond, body, carry0)
 
         return fused
 
     def _cand_cap_for(self, run_cap: int) -> int:
         """Candidate-buffer capacity for a run bucket: a quarter of the
         action grid (valid slots are typically a minority), power-of-four
-        bucketed, grown on overflow. Cached per model so repeated checkers
-        keep learned capacities alongside the compiled programs."""
-        caps = self._model.__dict__.setdefault("_xla_cand_caps", {})
+        bucketed, grown on overflow. Cached per CHECKER (so two live
+        checkers over one model can't resize each other's buffers
+        mid-run), seeded from and written back to per-model hints so a
+        fresh checker still inherits learned growths (see __init__)."""
+        caps = self._cand_caps
         cap = caps.get(run_cap)
         if cap is None:
-            m = run_cap * self._A
-            if run_cap <= 256:
-                # Small buckets take the FULL grid: compaction saves
-                # nothing at this scale, and an undersized buffer costs a
-                # cc_ovf -> grow -> fresh-XLA-compile round per growth —
-                # the dominant warm-pass term for ramping spaces once the
-                # bucket ladder starts at 64.
-                cap = self._next_pow2(m)
-            else:
-                # Power-of-two (not four): a pow4 ladder can land just
-                # above the target at the big buckets and erase most of
-                # the compaction win. The initial fraction is a guess the
-                # cc_ovf protocol self-corrects (warm pass pays the grow
-                # compiles; the measured pass replays learned caps): CPU
-                # keeps the round-2 m/4; accelerators start at m/16 —
-                # per-level cost there scales with sorted lane-words
-                # x log2^2(n) (round-5 profile), so a snugger candidate
-                # buffer directly shrinks the insert's merge sort (rm=8
-                # real peak validity is ~11% of the grid). STPU_CAND_FRAC
-                # overrides the denominator for A/Bs.
-                import jax as _jax
-
-                den = int(os.environ.get(
-                    "STPU_CAND_FRAC",
-                    "4" if _jax.default_backend() == "cpu" else "16",
-                ))
-                cap = max(1024, self._next_pow2(max(m // den, 1)))
-            caps[run_cap] = cap = min(cap, self._next_pow2(m))
+            caps[run_cap] = cap = self._default_cand_cap(run_cap)
         return cap
+
+    def _default_cand_cap(self, run_cap: int) -> int:
+        """The cap :meth:`_cand_cap_for` would size a so-far-unseen bucket
+        at — split out non-mutating so the sibling eviction guard in
+        :meth:`_grow_cand_cap` can probe another live checker's would-be
+        sizing without inserting entries into its cap dict."""
+        m = run_cap * self._A
+        if run_cap <= 256:
+            # Small buckets take the FULL grid: compaction saves
+            # nothing at this scale, and an undersized buffer costs a
+            # cc_ovf -> grow -> fresh-XLA-compile round per growth —
+            # the dominant warm-pass term for ramping spaces once the
+            # bucket ladder starts at 64.
+            cap = self._next_pow2(m)
+        else:
+            # Power-of-two (not four): a pow4 ladder can land just
+            # above the target at the big buckets and erase most of
+            # the compaction win. The initial fraction is a guess the
+            # cc_ovf protocol self-corrects (warm pass pays the grow
+            # compiles; the measured pass replays learned caps): CPU
+            # keeps the round-2 m/4; accelerators start at m/16 —
+            # per-level cost there scales with sorted lane-words
+            # x log2^2(n) (round-5 profile), so a snugger candidate
+            # buffer directly shrinks the insert's merge sort (rm=8
+            # real peak validity is ~11% of the grid). STPU_CAND_FRAC
+            # overrides the denominator for A/Bs.
+            import jax as _jax
+
+            den = int(os.environ.get(
+                "STPU_CAND_FRAC",
+                "4" if _jax.default_backend() == "cpu" else "16",
+            ))
+            cap = max(1024, self._next_pow2(max(m // den, 1)))
+        return min(cap, self._next_pow2(m))
 
     @staticmethod
     def _next_pow2(n: int) -> int:
         return 1 << max(n - 1, 1).bit_length()
 
     def _grow_cand_cap(self, run_cap: int) -> None:
-        caps = self._model.__dict__.setdefault("_xla_cand_caps", {})
         m = run_cap * self._A
         old = self._cand_cap_for(run_cap)
-        caps[run_cap] = min(old * 4, self._next_pow2(m))
-        # Evict the outgrown bucket's compiled programs — they can never be
-        # hit again (lookups always use the current cand cap) and each one
-        # holds a full XLA executable.
+        new = min(old * 4, self._next_pow2(m))
+        self._cand_caps[run_cap] = new
+        hints = self._model.__dict__.setdefault("_xla_cand_cap_hints", {})
+        hints[run_cap] = max(hints.get(run_cap, 0), new)
+        # Evict outgrown compiled programs — THIS checker's lookups always
+        # use the grown cap, and a fresh checker seeds from the (just
+        # raised) hints, so the old-cap programs are dead weight holding
+        # full XLA executables — UNLESS a live, still-RUNNING sibling
+        # checker sizes this bucket at the old cap (caps are per-checker,
+        # the cache is model-shared): evicting under it would force it to
+        # re-pay a compile for a program that is still current for it. A
+        # finished sibling never dispatches again, so a lingering
+        # reference to one doesn't pin its outgrown executables.
+        # A fused program is stale only when its rung tuple actually
+        # CHANGES under the grown caps: an outgrown sub-rung whose cap
+        # was already clamped by the monotone envelope recomputes
+        # identically, and evicting it would force a byte-identical
+        # recompile (~11 s/bucket on this box, ~1 min on the tunnel).
+        pinning = [
+            (s._symmetry, s._max_probes, s._dedup, s._compaction)
+            for s in self._siblings()
+            if not s.is_done()
+            and s._cand_caps.get(run_cap, s._default_cand_cap(run_cap)) == old
+        ]
         for key in [
             k
             for k in self._superstep_cache
-            if (k[0] == run_cap and k[1] == old)
-            or (k[0] == "fused" and k[1] == run_cap and k[2] == old)
+            if (
+                (k[0] == run_cap and k[1] == old)
+                or (
+                    k[0] == "fused"
+                    and any(F == run_cap and c == old for F, c in k[2])
+                    and tuple(self._cand_rungs(k[1])) != k[2]
+                )
+            )
+            # Per-key pinning: a sibling protects only keys its own
+            # engine config can look up (dedup/compaction are part of
+            # the key — a hash sibling can never reach a sorted key).
+            and (k[3:] if k[0] == "fused" else k[2:]) not in pinning
         ]:
             del self._superstep_cache[key]
+
+    def _siblings(self) -> List["XlaChecker"]:
+        """Other live checkers over this model (weakrefs registered in
+        ``__init__``; dead refs are pruned on the way out)."""
+        live = self._model.__dict__.get("_xla_live_checkers", [])
+        live[:] = [r for r in live if r() is not None]
+        return [c for r in live if (c := r()) is not None and c is not self]
+
+    #: In-program candidate-ladder rung floor: sub-widths below this gain
+    #: nothing (buckets <= 256 run full-grid candidate buffers and their
+    #: sorts are batch-trivial) while every rung is a full superstep
+    #: traced into the fused program — compile cost, not savings.
+    CAND_RUNG_FLOOR = 256
+    #: Headroom multiplier on the device-side candidate estimate. An
+    #: underestimate costs one wasted snug superstep (the in-program
+    #: fall-through re-runs the level full-width), so the estimate is
+    #: doubled before picking a rung; the exact ``f_count * A`` bound
+    #: still wins whenever the whole sub-grid fits a rung.
+    CAND_EST_MARGIN = 2.0
+
+    def _cand_rungs(self, f_cap: int) -> List[Tuple[int, int]]:
+        """The in-program candidate ladder for a fused dispatch at bucket
+        ``f_cap``: ascending ``[(F_k, C_k)]`` sub-width shapes, last = the
+        full bucket. Each rung is exactly the (rows, candidate-cap) shape
+        the host ladder would run at bucket ``F_k``, specialised into the
+        peak program — so a branch's committed level is bit-identical to
+        what a host re-dispatch at that bucket would have produced,
+        without the re-dispatch."""
+        full = (f_cap, self._cand_cap_for(f_cap))
+        if self._cand_ladder_k <= 1 or not self._soa:
+            return [full]
+        rungs = [full]
+        Fk = f_cap
+        while len(rungs) < self._cand_ladder_k:
+            Fk //= 4
+            if Fk < self.CAND_RUNG_FLOOR:
+                break
+            # Monotone envelope: a cc_ovf growth at a SMALL bucket (its
+            # own host dispatches) can push that bucket's learned cap
+            # past a bigger bucket's — unclamped, the "snug" rung would
+            # then sort a WIDER candidate buffer than the branch above
+            # it, inverting the ladder's savings while the telemetry
+            # reports the inflated cap as snug. Clamp each rung to the
+            # next rung up; an undersized clamp only costs the
+            # in-program fall-through, never a dropped candidate.
+            rungs.append((Fk, min(self._cand_cap_for(Fk), rungs[-1][1])))
+        rungs.reverse()
+        return rungs
+
+    def _level_lane_words(self, bucket: int, cand_w: int) -> int:
+        """32-bit words carried through ``lax.sort`` operands by ONE
+        committed level at these dispatch shapes — the x-axis of the
+        round-5 cost law (per-level time ~ sorted lane-words x log^2 n,
+        BASELINE.md). Computed from the actual static sort shapes the
+        compiled program runs (grid compaction + visited-set insert +
+        frontier compaction at engine scale; the hv_cap- and
+        symmetry-only side sorts are bounded and not counted), so the
+        candidate-ladder A/B is engine-measured, not hand-derived. The
+        rows/hash engine sorts nothing (cumsum + scatter compaction)."""
+        if not self._soa:
+            return 0
+        W = self._W
+        grid = bucket * self._A
+        total = 0
+        if self._compaction == "sort":
+            # Grid: key + W state planes; frontier: key + W rows + ebits.
+            total += grid * (1 + W) + cand_w * (2 + W)
+        elif self._compaction == "gather":
+            # Permutation sorts only (key + iota); payloads move by gather.
+            total += grid * 2 + cand_w * 2
+        # bsearch/pallas compactions are scan/kernel lowerings: no sorted
+        # lanes at engine scale (their sub-block sort fallbacks are not
+        # modeled — both modes are opt-in A/Bs).
+        total += self._ds.insert_lane_words(self._table, cand_w)
+        return total
 
     def _superstep_for(self, f_cap: int):
         import jax
@@ -1400,14 +1733,14 @@ class XlaChecker(Checker):
     def _fused_for(self, f_cap: int):
         import jax
 
-        cand_cap = self._cand_cap_for(f_cap)
+        rungs = tuple(self._cand_rungs(f_cap))
         key = (
-            "fused", f_cap, cand_cap, self._symmetry, self._max_probes,
+            "fused", f_cap, rungs, self._symmetry, self._max_probes,
             self._dedup, self._compaction,
         )
         fn = self._superstep_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._build_fused(f_cap, cand_cap))
+            fn = jax.jit(self._build_fused(f_cap, rungs))
             self._superstep_cache[key] = fn
         return fn
 
@@ -1522,9 +1855,13 @@ class XlaChecker(Checker):
         for k in self._superstep_cache:
             if fused != (k[0] == "fused"):
                 continue
-            f_cap, cand_cap = (k[1], k[2]) if fused else (k[0], k[1])
-            tail = k[3:] if fused else k[2:]
-            if tail == tail_want and cand_cap == self._cand_cap_for(f_cap):
+            if fused:
+                f_cap, tail = k[1], k[3:]
+                current = k[2] == tuple(self._cand_rungs(f_cap))
+            else:
+                f_cap, tail = k[0], k[2:]
+                current = k[1] == self._cand_cap_for(f_cap)
+            if tail == tail_want and current:
                 caps.add(f_cap)
         return caps
 
@@ -1692,6 +2029,13 @@ class XlaChecker(Checker):
                 smaller = [c for c in self._compiled_run_caps() if c < run_cap]
                 if smaller:
                     shrink_below = max(smaller) // 4
+            # Seed the device-side candidate estimate with the last two
+            # committed levels' generated counts (the host's level_log is
+            # the cross-dispatch memory; runtime scalars, zero compiles).
+            prev_gen = self.level_log[-1]["generated"] if self.level_log else 0
+            prev2_gen = (
+                self.level_log[-2]["generated"] if len(self.level_log) > 1 else 0
+            )
             (
                 committed,
                 nf,
@@ -1709,6 +2053,12 @@ class XlaChecker(Checker):
                 lvl_frontier,
                 lvl_states,
                 lvl_unique,
+                lvl_bucket,
+                lvl_cand,
+                _prev_gen,
+                _prev2_gen,
+                _force_full,
+                n_retries,
             ) = fn(
                 f_in,
                 e_in,
@@ -1720,6 +2070,8 @@ class XlaChecker(Checker):
                 jnp.int32(remaining),
                 jnp.asarray(host_found),
                 jnp.int32(shrink_below),
+                jnp.int32(min(prev_gen, 2**31 - 1)),
+                jnp.int32(min(prev2_gen, 2**31 - 1)),
             )
             # Commit the non-overflowing prefix of the block.
             committed = int(committed)
@@ -1729,16 +2081,28 @@ class XlaChecker(Checker):
             self._disc_found, self._disc_fp = dfound, dfp
             self._state_count += int(tot_states)
             self._unique_count += int(tot_unique)
+            self.cand_retries += int(n_retries)
             if committed:
                 lvf = np.asarray(lvl_frontier)
                 lvs = np.asarray(lvl_states)
                 lvu = np.asarray(lvl_unique)
+                lvb = np.asarray(lvl_bucket)
+                lvc = np.asarray(lvl_cand)
                 self.level_log.extend(
                     {
                         "depth": self._depth + i,
                         "frontier": int(lvf[i]),
                         "generated": int(lvs[i]),
                         "unique": int(lvu[i]),
+                        # Dispatch-shape telemetry: the (rows, cand)
+                        # sub-widths this level actually ran at and the
+                        # cost-law lane-words they imply (the ladder A/B's
+                        # engine-measured evidence).
+                        "bucket": int(lvb[i]),
+                        "cand_cap": int(lvc[i]),
+                        "lane_words": self._level_lane_words(
+                            int(lvb[i]), int(lvc[i])
+                        ),
                     }
                     for i in range(committed)
                 )
@@ -1865,6 +2229,14 @@ class XlaChecker(Checker):
                 "frontier": self._frontier_count,
                 "generated": int(d_states),
                 "unique": int(d_unique),
+                # The one-level path picks its snug bucket host-side, so
+                # its dispatch-shape telemetry is the run bucket itself
+                # (the in-program ladder applies to fused dispatch only).
+                "bucket": run_cap,
+                "cand_cap": self._cand_cap_for(run_cap),
+                "lane_words": self._level_lane_words(
+                    run_cap, self._cand_cap_for(run_cap)
+                ),
             }
         )
         self._frontier, self._frontier_ebits, self._table = nf, ne, table

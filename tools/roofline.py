@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 
 PEAK_GBPS = 819.0  # TPU v5e HBM
@@ -119,6 +120,35 @@ def model_ceiling(detail) -> dict:
     }
 
 
+def cost_law_rows(detail) -> list:
+    """Predicted-vs-measured cost-law rows from the engine's per-level
+    sorted-lane-words telemetry (level rows carry ``lane_words`` /
+    ``cand_cap`` / ``bucket`` since the candidate-ladder round — the
+    ACTUAL static sort shapes the compiled program ran, so this replaces
+    the hand-derived per-level figure the byte model above guesses at).
+    One row per dispatch block: the block's wall-clock is the
+    tunnel-visible measured unit; its predicted sort seconds are
+    lane-words x 4 bytes x SORT_PASSES / achievable bandwidth."""
+    bw = PEAK_GBPS * 1e9 * EFFICIENCY
+    rows = []
+    for block in detail.get("levels", []):
+        lvls = block.get("levels", [])
+        lw = [l.get("lane_words") for l in lvls]
+        if not lvls or any(w is None for w in lw):
+            continue
+        total_lw = sum(lw)
+        rows.append(
+            {
+                "levels": len(lvls),
+                "lane_words": total_lw,
+                "cand_caps": sorted({l.get("cand_cap") for l in lvls}),
+                "predicted_sort_s": round(total_lw * 4 * SORT_PASSES / bw, 5),
+                "measured_s": block.get("sec"),
+            }
+        )
+    return rows
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     path = args[0] if args else "bench_detail.json"
@@ -127,7 +157,43 @@ def main() -> None:
 
     if "--model" in sys.argv:
         out = model_ceiling(detail)
+        law = cost_law_rows(detail)
+        if law:
+            levels = [l for b in detail["levels"] for l in b.get("levels", [])]
+            # Mirror cost_law_rows' guard: a mixed detail file (a block
+            # appended from a pre-ladder run) must degrade, not KeyError.
+            per_level = sorted(
+                w for l in levels if (w := l.get("lane_words")) is not None
+            )
+            out["cost_law"] = {
+                "rows": law,
+                "instrumented_levels": len(per_level),
+                "lane_words_total": sum(per_level),
+                "lane_words_per_level": {
+                    # statistics.median matches bench.py and cand_ab.py.
+                    "median": statistics.median(per_level),
+                    "mean": round(sum(per_level) / len(per_level)),
+                    "max": per_level[-1],
+                },
+                "predicted_sort_s": round(
+                    sum(r["predicted_sort_s"] for r in law), 4
+                ),
+                "measured_s": round(
+                    sum(r["measured_s"] or 0 for r in law), 4
+                ),
+            }
         print(json.dumps(out, indent=1))
+        if law:
+            cl = out["cost_law"]
+            print(
+                f"# engine-measured cost law: {cl['lane_words_total']:,} "
+                f"sorted lane-words over {cl['instrumented_levels']} "
+                f"instrumented levels (of {out['levels']}) "
+                f"(median {cl['lane_words_per_level']['median']:,}/level, "
+                f"mean {cl['lane_words_per_level']['mean']:,}/level); "
+                f"predicted sort time {cl['predicted_sort_s']:.3f}s vs "
+                f"measured {cl['measured_s']:.3f}s"
+            )
         ns_gap = 50e6 / max(out["ceiling_states_per_sec"], 1)
         print(
             f"# modeled ceiling {out['ceiling_states_per_sec']/1e6:.1f} M gen/s "

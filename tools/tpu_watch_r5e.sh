@@ -85,6 +85,13 @@ while true; do
     # 4. engine-level packed-keys A/B
     run_tool packed_ab 2400 tpu_packed_ab.log \
       python tools/packed_ab.py 8 || { sleep 240; continue; }
+    # 4b. in-program candidate-ladder A/B (rm=8, sorted x ramp/jump;
+    #     the switch branches carry the [table ‖ cand] merge sort — the
+    #     registry-#4-adjacent shape — so this stage is ALSO the runtime
+    #     fault probe the TPU lowering pre-flight cannot give; delta
+    #     pairs stay out until delta_diag localizes the registry-#4 fault)
+    run_tool cand_ab 2400 tpu_cand_ab.log \
+      python tools/cand_ab.py 8 --quick || { sleep 240; continue; }
     # 5. delta-fault bisect: standalone programs across the shape ladder
     run_tool delta_diag 2400 tpu_delta_diag.log \
       python tools/delta_diag.py 22 || { sleep 240; continue; }
