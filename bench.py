@@ -17,15 +17,23 @@ north-star of 50M states/sec (BASELINE.md).
 **Hang-proofing**: the axon TPU tunnel can WEDGE — not fail — at any point
 (observed: ``jax.devices()`` blocking forever, and a dispatch mid-run
 blocking after a successful probe). All device work therefore runs in a
-child process under a hard ``BENCH_WORKER_TIMEOUT_S`` watchdog with
-``BENCH_TPU_RETRIES`` retries (the persistent compile cache makes retries
-cheap); only after the retries are spent does the harness fall back to a
-CPU child. Probe diagnostics and per-pass progress go to stderr and
-``bench_probe.log`` so a hang is attributable post-mortem.
+child process under a watchdog that is **heartbeat-aware** (the obs layer,
+docs/observability.md): the worker's engines rewrite
+``runs/heartbeat.json`` around every device dispatch, so the parent kills
+on *staleness in-band* — a worker mid-``phase="dispatch"`` whose beat goes
+stale past ``BENCH_STALL_S`` is a wedged tunnel (the leash stretches 3x
+when the beat says the dispatch carries a fresh XLA compile), while a
+beating worker may run to the hard ``BENCH_WORKER_TIMEOUT_S`` cap.
+``BENCH_TPU_RETRIES`` retries follow (the persistent compile cache makes
+retries cheap); only after the retries are spent does the harness fall
+back to a CPU child. Probe diagnostics and per-pass progress go to stderr
+and ``runs/bench_probe.log`` so a hang is attributable post-mortem.
 
-Per-level timing detail is written to ``bench_detail.json`` (levels,
+Per-level timing detail is written to ``runs/bench_detail.json`` (levels,
 frontier widths, per-level seconds, compile vs steady split) for the
-BASELINE.md breakdown.
+BASELINE.md breakdown. With ``STPU_TRACE`` set the workers additionally
+emit the span JSONL (``tools/roofline.py --measured`` consumes it); the
+trace and heartbeat paths are recorded in ``runs/bench_detail.json``.
 """
 
 from __future__ import annotations
@@ -38,6 +46,9 @@ import time
 
 NORTH_STAR = 50_000_000.0
 REPO = os.path.dirname(os.path.abspath(__file__))
+# Fresh run artifacts (detail JSON, probe log, heartbeat, traces) land
+# under runs/ — the repo root stays clean (.gitignore rules match).
+RUNS = os.path.join(REPO, "runs")
 
 # Pinned full-coverage (generated, unique) counts. Exact counts are the
 # product guarantee (the reference asserts them in its example tests, e.g.
@@ -93,7 +104,8 @@ def _audit(checker) -> dict:
 
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
-    with open(os.path.join(REPO, "bench_probe.log"), "a") as fh:
+    os.makedirs(RUNS, exist_ok=True)
+    with open(os.path.join(RUNS, "bench_probe.log"), "a") as fh:
         fh.write(f"{time.strftime('%H:%M:%S')} {msg}\n")
 
 
@@ -426,12 +438,19 @@ def _worker(platform: str) -> None:
     )
 
     def write_detail(matrix):
-        with open(os.path.join(REPO, "bench_detail.json"), "w") as fh:
+        os.makedirs(RUNS, exist_ok=True)
+        with open(os.path.join(RUNS, "bench_detail.json"), "w") as fh:
             json.dump(
                 {
                     "platform": platform,
                     "backend": jax.default_backend(),
                     "rm": rm,
+                    # Obs artifacts of this run (docs/observability.md):
+                    # the span JSONL (tools/roofline.py --measured reads
+                    # it) and the watchdog heartbeat, when enabled.
+                    "trace": os.environ.get("STPU_TRACE") or None,
+                    "heartbeat": os.environ.get("STPU_HEARTBEAT") or None,
+                    "metrics": checker.metrics(),
                     "table_capacity": checker._table.capacity,
                     "cand_ladder": checker._cand_ladder_k,
                     "cand_retries": checker.cand_retries,
@@ -471,39 +490,149 @@ def _json_lines(text) -> list:
     return [l for l in (text or "").splitlines() if l.strip().startswith("{")]
 
 
-def _spawn_worker(platform: str, timeout_s: float) -> str | None:
-    """Runs ``bench.py --worker <platform>`` under a hard timeout; returns
-    the worker's primary JSON line or None. A worker killed by the watchdog
-    mid-matrix still counts as success if it printed the primary line first.
-    The worker's stderr streams to ours (it logs to bench_probe.log)."""
-    t0 = time.monotonic()
+def _hb_read(path: str) -> dict | None:
+    """Parsed heartbeat, or None (inline stdlib read — the parent stays
+    free of package imports; schema: stateright_tpu/obs/heartbeat.py)."""
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker", platform],
-            timeout=timeout_s,
-            stdout=subprocess.PIPE,
-            text=True,
-            cwd=REPO,
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _spawn_worker(platform: str, timeout_s: float) -> str | None:
+    """Runs ``bench.py --worker <platform>`` under the heartbeat-aware
+    watchdog; returns the worker's primary JSON line or None.
+
+    The worker's engines rewrite the heartbeat file around every device
+    dispatch (STPU_HEARTBEAT, injected here unless BENCH_HEARTBEAT=0), so
+    the parent distinguishes in-band instead of guessing from one hard
+    timeout: a stale beat in ``phase="dispatch"`` is a wedged tunnel
+    (leash ``BENCH_STALL_S``, stretched 3x when the beat flags an XLA
+    compile); a worker that never beats gets ``BENCH_STARTUP_GRACE_S``
+    (imports + init inserts can wedge before the first dispatch); a
+    beating worker may run to the hard ``timeout_s`` cap. A worker killed
+    mid-matrix still counts as success if it printed the primary line
+    first. The worker's stderr streams to ours (it logs to
+    runs/bench_probe.log)."""
+    os.makedirs(RUNS, exist_ok=True)
+    env = dict(os.environ)
+    hb_path = None
+    if os.environ.get("BENCH_HEARTBEAT", "1") != "0":
+        hb_path = os.environ.get("STPU_HEARTBEAT") or os.path.join(
+            RUNS, "heartbeat.json"
         )
-    except subprocess.TimeoutExpired as e:
-        salvage = _json_lines(e.stdout)
+        env["STPU_HEARTBEAT"] = hb_path
+    if platform == "cpu":
+        # No tunnel, no wedge: the staleness kill exists for the axon
+        # transport, and on this 1-core box a long steady dispatch is
+        # routine — only the hard timeout supervises the CPU fallback.
+        # Popped from the child env too: an outer watcher
+        # (tools/tpu_watch.sh) supervising the same heartbeat path must
+        # not see CPU-paced dispatch beats and kill the fallback run.
+        hb_path = None
+        env.pop("STPU_HEARTBEAT", None)
+    # The leash must out-wait a HEALTHY steady dispatch: a fused device
+    # call covers up to levels_per_dispatch=32 BFS levels with no beat in
+    # between, which at soak scale legitimately runs many minutes.
+    stall_s = float(os.environ.get("BENCH_STALL_S", "1200"))
+    startup_grace_s = float(os.environ.get("BENCH_STARTUP_GRACE_S", "900"))
+    t0 = time.monotonic()
+    wall0 = time.time()  # beats older than this are a previous run's
+    # Worker stdout goes to a file, not a pipe: the parent never reads
+    # concurrently, so a pipe could deadlock a chatty worker; a file also
+    # survives for post-mortem salvage no matter how the worker dies.
+    stdout_path = os.path.join(RUNS, f"worker_{platform}.out")
+    stdout_fh = open(stdout_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", platform],
+        stdout=stdout_fh,
+        text=True,
+        cwd=REPO,
+        env=env,
+    )
+    killed = None
+    while True:
+        try:
+            proc.wait(timeout=5)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        elapsed = time.monotonic() - t0
+        if elapsed > timeout_s:
+            killed = f"hard timeout {timeout_s:.0f}s"
+            break
+        if hb_path is None:
+            continue
+        try:
+            mtime = os.stat(hb_path).st_mtime
+        except OSError:
+            mtime = None
+        if mtime is None or mtime < wall0:
+            # No beat from THIS worker yet: startup (jax import, model
+            # build, init inserts) gets its own grace, then counts as a
+            # pre-dispatch wedge.
+            if elapsed > startup_grace_s:
+                killed = f"no heartbeat within {startup_grace_s:.0f}s startup grace"
+                break
+            continue
+        age = time.time() - mtime
+        rec = _hb_read(hb_path) or {}
+        if rec.get("phase") != "dispatch":
+            # Stale in phase="idle" is HOST-side work (audit readbacks,
+            # matrix model builds, witness reconstruction), not the
+            # tunnel — the protocol says leave it alone (a dead process
+            # is caught by proc.wait above, a runaway host loop by the
+            # hard timeout).
+            continue
+        allow = stall_s * (3 if rec.get("compile") else 1)
+        if age > allow:
+            killed = (
+                f"heartbeat stale {age:.0f}s > {allow:.0f}s mid-dispatch "
+                f"(compile={bool(rec.get('compile'))}, "
+                f"seq={rec.get('seq', '?')}) — wedged tunnel"
+            )
+            break
+    def _clear_heartbeat():
+        # The heartbeat is LIVE supervision state, not an artifact: once
+        # this worker is gone its file must not linger — a dead worker's
+        # final phase="dispatch" beat would read as a wedged tunnel to an
+        # outer watcher (tools/tpu_watch.sh) and get the stage's whole
+        # process group killed while a retry / CPU fallback is healthy.
+        if hb_path:
+            try:
+                os.unlink(hb_path)
+            except OSError:
+                pass
+
+    if killed is not None:
+        proc.kill()
+        proc.wait()
+        _clear_heartbeat()
+        stdout_fh.close()
+        with open(stdout_path) as fh:
+            salvage = _json_lines(fh.read())
         if salvage:
             _log(
-                f"{platform} worker killed at {timeout_s:.0f}s but the "
-                "primary metric was already out; using it"
+                f"{platform} worker killed ({killed}) but the primary "
+                "metric was already out; using it"
             )
             return salvage[0]
-        _log(f"{platform} worker WEDGED/timed out after {timeout_s:.0f}s; killed")
+        _log(f"{platform} worker killed: {killed}")
         return None
+    _clear_heartbeat()
+    stdout_fh.close()
+    with open(stdout_path) as fh:
+        out = fh.read()
     dt = time.monotonic() - t0
-    lines = _json_lines(proc.stdout)
+    lines = _json_lines(out)
     if not lines:
         _log(f"{platform} worker rc={proc.returncode} in {dt:.0f}s, no JSON line")
         return None
     if proc.returncode != 0:
-        # Killed (wedged mid-matrix and externally terminated, OOM, ...)
+        # Died (wedged mid-matrix and externally terminated, OOM, ...)
         # AFTER the primary metric went out: the measurement happened —
-        # use it, exactly like the watchdog-timeout salvage above.
+        # use it, exactly like the watchdog salvage above.
         _log(
             f"{platform} worker rc={proc.returncode} in {dt:.0f}s but the "
             "primary metric was already out; using it"

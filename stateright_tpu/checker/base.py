@@ -50,6 +50,19 @@ class Checker:
         """Advance the search by a bounded amount of work (engine hook)."""
         raise NotImplementedError
 
+    def metrics(self) -> Dict[str, Any]:
+        """A unified telemetry snapshot (stateright_tpu/obs;
+        docs/observability.md). The base form carries the counters every
+        engine has; the device engines override with the full registry
+        (dispatch/growth/flush counters, occupancy and capacity gauges).
+        Safe to poll mid-run — the Explorer's ``/.status`` does."""
+        return {
+            "engine": type(self).__name__,
+            "state_count": self.state_count(),
+            "unique_state_count": self.unique_state_count(),
+            "max_depth": self.max_depth(),
+        }
+
     _started = False
 
     def _ensure_started(self) -> None:

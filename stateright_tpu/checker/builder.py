@@ -100,6 +100,16 @@ class CheckerBuilder:
         zero added host round-trips; ``STPU_CAND_LADDER`` is the env
         form, 1 disables, planes engine only).
 
+        Observability (``stateright_tpu.obs``, docs/observability.md):
+        ``trace=`` appends wall-clock spans around every host↔device
+        boundary as JSONL (env ``STPU_TRACE``; ``STPU_TRACE_CHROME``
+        additionally exports Chrome trace-event JSON for Perfetto), and
+        ``heartbeat=`` names a small JSON file rewritten around every
+        device dispatch so watchdogs can tell a wedged tunnel from a
+        long XLA compile (env ``STPU_HEARTBEAT``). Both off by default;
+        neither adds device syncs. ``checker.metrics()`` returns the
+        unified counters/gauges snapshot either way.
+
         With ``mesh`` (a ``jax.sharding.Mesh`` with one axis, more than one
         device), the frontier and visited set shard by fingerprint ownership
         over the mesh with all-to-all routing per super-step

@@ -183,6 +183,15 @@ class DeviceOnDemandChecker(XlaChecker):
 
     # --- Checker API adjustments (mirror checker/on_demand.py) -------------
 
+    def metrics(self):
+        """The engine registry plus the on-demand surface's own gauges:
+        the pending pool (discovered-but-unexpanded states) and whether
+        the checker is still waiting (compute-nothing-until-asked)."""
+        out = super().metrics()
+        out["pending_pool"] = len(self._pool)
+        out["waiting"] = self._waiting
+        return out
+
     def is_done(self) -> bool:
         if self._waiting:
             return (

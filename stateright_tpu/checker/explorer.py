@@ -98,6 +98,11 @@ class ExplorerApp:
                 "max_depth": checker.max_depth(),
                 "properties": self._properties(),
                 "recent_path": repr(recent) if recent is not None else None,
+                # The unified obs snapshot (docs/observability.md): on the
+                # device backend this is the full engine registry
+                # (occupancy, dispatch/growth counters); host backends
+                # report the base counters.
+                "metrics": checker.metrics(),
             }
 
     def run_to_completion(self) -> None:

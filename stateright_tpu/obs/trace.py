@@ -1,0 +1,209 @@
+"""Span tracer: append-only JSONL + Chrome trace-event export.
+
+One JSON object per line, flushed as written (a wedged tunnel mid-run must
+not take the spans before it), schema::
+
+    {"ts": <float, seconds since tracer start>,
+     "dur": <float, seconds>,
+     "name": <str>,
+     "attrs": {<span attributes>}}
+
+The first line of every tracer is a ``trace_start`` span (dur 0) carrying
+``pid`` and the absolute ``unix_ts`` of the tracer epoch, so traces from
+several processes can be aligned. Span names the engines emit:
+
+``dispatch``
+    One host→device→host round-trip of a compiled superstep program (one
+    or many BFS levels). Attrs: ``flavor`` (``fused``/``single``),
+    ``bucket`` (run rows), ``cand`` (candidate cap, or the ladder's rung
+    list under fused dispatch), ``committed`` (levels committed — 0 means
+    an overflow exit), ``compile`` (this call traced+compiled a fresh XLA
+    program: its wall-clock includes the compile), ``retry`` (re-run of a
+    level after an overflow recovery), ``dedup``, ``compaction``, and —
+    fused path — ``shrink_below`` when a shrink-exit threshold is armed.
+``grow_table``
+    Visited-set growth (rehash / plane copy) — the overflow-recovery
+    device work. Attrs: ``dedup``, ``capacity`` (new).
+``delta_flush``
+    The delta structure's host-invoked ``maintain`` merge. Attrs:
+    ``proactive`` (load-rule flush at a dispatch boundary vs an
+    overflow-triggered one).
+``host_verify``
+    Host-side exact re-check of device-flagged candidates for
+    host-verified properties. Attrs: ``checked``, ``confirmed``.
+
+The exporter (:func:`export_chrome`) rewrites a span JSONL as one Chrome
+trace-event JSON object (``{"traceEvents": [...]}``, complete events,
+microsecond times) — the format Perfetto and ``chrome://tracing`` load
+directly.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class Span:
+    """Context manager recording one wall-clock span; attributes may be
+    added mid-span with :meth:`set` (e.g. counts only known after the
+    host syncs the dispatch results)."""
+
+    __slots__ = ("_tracer", "name", "attrs", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t0 = self._t0
+        self._tracer._emit(self.name, t0, time.monotonic() - t0, self.attrs)
+        return False
+
+
+class _NullSpan:
+    """The do-nothing span: tracing off costs two attribute lookups and a
+    shared-singleton return — no clock reads, no allocation, no I/O."""
+
+    __slots__ = ()
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullTracer:
+    enabled = False
+
+    def span(self, name: str, **attrs: Any) -> _NullSpan:
+        return _NULL_SPAN
+
+    def close(self) -> None:
+        pass
+
+
+#: The shared off-switch: engines hold this when no trace is configured.
+NULL_TRACER = _NullTracer()
+
+
+class Tracer:
+    """Append-only JSONL span writer (see module docstring for schema)."""
+
+    enabled = True
+
+    def __init__(self, path: str, chrome_path: Optional[str] = None):
+        self.path = path
+        self.chrome_path = chrome_path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "a")
+        self._epoch = time.monotonic()
+        self._emit(
+            "trace_start", self._epoch, 0.0,
+            {"pid": os.getpid(), "unix_ts": time.time()},
+        )
+        if chrome_path is not None:
+            # Best-effort export when the process ends — checkers have no
+            # close hook, and an explicit export_chrome() call (bench.py,
+            # tests) always works regardless.
+            atexit.register(self.close)
+
+    @property
+    def closed(self) -> bool:
+        return self._fh.closed
+
+    def span(self, name: str, **attrs: Any) -> Span:
+        return Span(self, name, attrs)
+
+    def _emit(self, name: str, t0: float, dur: float, attrs: Dict[str, Any]) -> None:
+        if self._fh.closed:  # post-close span from a lingering checker
+            return
+        self._fh.write(
+            json.dumps(
+                {
+                    "ts": round(t0 - self._epoch, 6),
+                    "dur": round(dur, 6),
+                    "name": name,
+                    "attrs": attrs,
+                },
+                default=str,
+            )
+            + "\n"
+        )
+        self._fh.flush()
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+        if self.chrome_path is not None:
+            try:
+                export_chrome(self.path, self.chrome_path)
+            except OSError:  # pragma: no cover - exit-path best effort
+                pass
+
+
+def export_chrome(jsonl_path: str, out_path: str) -> int:
+    """Rewrites a span JSONL as Chrome trace-event JSON (complete "X"
+    events, microsecond clocks) that Perfetto / ``chrome://tracing`` open
+    directly. Returns the number of events written. Lines that do not
+    parse (a wedge mid-write) are skipped, not fatal."""
+    events = []
+    pid = os.getpid()
+    # An appended file can hold several tracer sessions (bench retries:
+    # one per worker process), each with its own zero-based monotonic
+    # epoch. Rebase every session onto the first one's wall clock via
+    # the unix_ts each trace_start records, so the exported timeline is
+    # sequential instead of all sessions overlapping at t=0.
+    base_unix = None
+    offset = 0.0
+    with open(jsonl_path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("name") == "trace_start":
+                attrs = rec.get("attrs", {})
+                pid = attrs.get("pid", pid)
+                u = attrs.get("unix_ts")
+                if u is not None:
+                    if base_unix is None:
+                        base_unix = u
+                    offset = u - base_unix
+                continue
+            events.append(
+                {
+                    "name": rec["name"],
+                    "cat": "stateright_tpu",
+                    "ph": "X",
+                    "ts": round((rec["ts"] + offset) * 1e6, 3),
+                    "dur": round(rec["dur"] * 1e6, 3),
+                    "pid": pid,
+                    "tid": 1,
+                    "args": rec.get("attrs", {}),
+                }
+            )
+    parent = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(parent, exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return len(events)

@@ -27,10 +27,11 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from .. import obs
 from ..checker.base import Checker
 from ..core import Expectation, Model
 from ..ops import deltaset, fphash, hashset, sortedset
-from ..xla import XlaChecker, _require_packed
+from ..xla import ENGINE_COUNTERS, XlaChecker, _require_packed
 
 # Owner mix constants: decorrelated from both the fingerprint lanes and the
 # hash-set slot mix (ops/hashset.py:76) so shard choice, slot choice, and
@@ -77,6 +78,8 @@ class ShardedXlaChecker(Checker):
         checkpoint: Optional[str] = None,
         dedup: str = "auto",
         host_verified_cap: int = 128,
+        trace=None,
+        heartbeat=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -192,6 +195,15 @@ class ShardedXlaChecker(Checker):
         self._found_names: Dict[str, int] = {}
         self._target_reached = False
         self._step_cache: Dict[Any, Any] = {}
+        # Observability (stateright_tpu/obs): same contract as the
+        # single-chip engine — spans/heartbeat around every SPMD dispatch,
+        # the unified dispatch_log shape ((run_rows, committed_levels) per
+        # device call, global rows here), and metrics() counters. The mesh
+        # engine adds a route-buffer growth counter to the shared seed.
+        self._tracer = obs.resolve_tracer(trace)
+        self._heartbeat = obs.resolve_heartbeat(heartbeat)
+        self._counters = obs.Counters(ENGINE_COUNTERS + ("route_grows",))
+        self.dispatch_log = []
 
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.
@@ -231,6 +243,7 @@ class ShardedXlaChecker(Checker):
         self._max_depth = 0
         self._state_count = n_init
         self._unique_count = int(n_unique_init)
+        self._frontier_total_cache = n_init
         self._exhausted = n_init == 0
 
     # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
@@ -284,6 +297,7 @@ class ShardedXlaChecker(Checker):
             ebits.reshape(D * Fl), self._plane_sharding
         )
         self._counts = jax.device_put(fcounts, self._plane_sharding)
+        self._frontier_total_cache = int(fcounts.sum())
 
         meta = ck["meta"]
         self._depth = meta["depth"]
@@ -1072,7 +1086,10 @@ class ShardedXlaChecker(Checker):
 
     def _counts_total(self) -> int:
         """Global frontier size: device-side psum, replicated output, so no
-        host ever touches the sharded counts plane directly."""
+        host ever touches the sharded counts plane directly. The result is
+        cached host-side (``_frontier_total_cache``) for passive readers —
+        ``metrics()`` must never enqueue device work (a poll from one
+        process of a multi-process mesh would desync SPMD program order)."""
         import jax
         import jax.numpy as jnp
 
@@ -1083,7 +1100,9 @@ class ShardedXlaChecker(Checker):
                 out_shardings=self._rep_sharding,
             )
             self.__dict__["_counts_total_fn"] = fn
-        return int(np.asarray(fn(self._counts)))
+        total = int(np.asarray(fn(self._counts)))
+        self._frontier_total_cache = total
+        return total
 
     def _parent_map(self):
         """The single-chip walk over a gathered copy of the table planes
@@ -1123,6 +1142,14 @@ class ShardedXlaChecker(Checker):
             )
 
     def _grow_table(self) -> None:
+        with self._tracer.span(
+            "grow_table", dedup=self._dedup, shards=self._D,
+            capacity=self._D * self._Cl * 2,
+        ):
+            self._grow_table_impl()
+        self._counters.inc("table_grows")
+
+    def _grow_table_impl(self) -> None:
         """Double every shard's table partition (ownership is capacity-
         independent, so growth stays shard-local: a plane copy for the
         sorted structure, a rehash for the hash table)."""
@@ -1230,6 +1257,7 @@ class ShardedXlaChecker(Checker):
         self._cap_hints()["table"] = D * new_Cl
 
     def _grow_route(self) -> None:
+        self._counters.inc("route_grows")
         self._K = min(self._Fl * self._A, self._K * 2)
         self._cap_hints()["route"] = self._K
 
@@ -1239,6 +1267,13 @@ class ShardedXlaChecker(Checker):
         ).setdefault(self._D, {})
 
     def _grow_frontier(self) -> None:
+        self._counters.inc("frontier_grows")
+        with self._tracer.span(
+            "grow_frontier", shards=self._D, rows=self._D * self._Fl * 2
+        ):
+            self._grow_frontier_impl()
+
+    def _grow_frontier_impl(self) -> None:
         """Double every shard's frontier rows, shard-locally on device (a
         host round-trip here would stall every growth event at scale)."""
         import jax.numpy as jnp
@@ -1312,6 +1347,10 @@ class ShardedXlaChecker(Checker):
                 self._found_names[name] = (int(fps[i, 0]) << 32) | int(fps[i, 1])
 
     def _confirm_hv_candidates(self, hv_w, hv_f, hv_c) -> None:
+        with self._tracer.span("host_verify"):
+            self._confirm_hv_impl(hv_w, hv_f, hv_c)
+
+    def _confirm_hv_impl(self, hv_w, hv_f, hv_c) -> None:
         """Exact host-side re-check of device-flagged candidate states for
         host-verified properties — the single-chip contract
         (xla.py ``_confirm_hv_candidates``) over the mesh's allgathered
@@ -1366,6 +1405,7 @@ class ShardedXlaChecker(Checker):
         budget_left = self._levels_per_dispatch
         if self._target_max_depth is not None:
             budget_left = min(budget_left, self._target_max_depth - self._depth)
+        retry = False  # re-entering after an overflow recovery
         while budget_left > 0:
             # Keep the block's int32 generated-state accumulator safe:
             # global candidates per level = D * Fl * A.
@@ -1379,39 +1419,59 @@ class ShardedXlaChecker(Checker):
             host_found = np.array(
                 [n in self._found_names for n in self._prop_names], dtype=bool
             )
+            n_cached = len(self._step_cache)
             fn = self._fused()
-            (
-                committed,
-                nf,
-                ne,
-                ncounts,
-                table,
-                dfound,
-                dfp,
-                tot_states,
-                tot_unique,
-                ovf,
-                hv_w,
-                hv_f,
-                hv_c,
-            ) = fn(
-                self._frontier,
-                self._frontier_ebits,
-                self._counts,
-                tuple(self._table),
-                self._disc_found,
-                self._disc_fp,
-                jnp.int32(budget),
-                jnp.int32(remaining),
-                jnp.asarray(host_found),
-            )
-            committed = int(np.asarray(committed))
+            fresh = len(self._step_cache) > n_cached
+            run_rows = self._D * self._Fl
+            if self._heartbeat is not None:
+                self._heartbeat.beat(
+                    "dispatch", compile=fresh, bucket=run_rows,
+                    depth=self._depth, states=self._state_count,
+                )
+            with self._tracer.span(
+                "dispatch", flavor="fused", bucket=run_rows,
+                cand=self._D * self._K, compile=fresh, retry=retry,
+                dedup=self._dedup, compaction="mesh", shards=self._D,
+            ) as _sp:
+                (
+                    committed,
+                    nf,
+                    ne,
+                    ncounts,
+                    table,
+                    dfound,
+                    dfp,
+                    tot_states,
+                    tot_unique,
+                    ovf,
+                    hv_w,
+                    hv_f,
+                    hv_c,
+                ) = fn(
+                    self._frontier,
+                    self._frontier_ebits,
+                    self._counts,
+                    tuple(self._table),
+                    self._disc_found,
+                    self._disc_fp,
+                    jnp.int32(budget),
+                    jnp.int32(remaining),
+                    jnp.asarray(host_found),
+                )
+                committed = int(np.asarray(committed))
+                _sp.set(committed=committed)
+            self.dispatch_log.append((run_rows, committed))
+            retry = False
             self._frontier, self._frontier_ebits = nf, ne
             self._counts = ncounts
             self._table = self._global_table(table)
             self._disc_found, self._disc_fp = dfound, dfp
             self._state_count += int(np.asarray(tot_states))
             self._unique_count += int(np.asarray(tot_unique))
+            if self._heartbeat is not None:
+                self._heartbeat.commit(
+                    depth=self._depth + committed, states=self._state_count
+                )
             self._depth += committed
             if committed:
                 self._max_depth = max(self._max_depth, self._depth - 1)
@@ -1436,12 +1496,15 @@ class ShardedXlaChecker(Checker):
                 # double past the blockage (see xla.py).
                 if not grew_proactively:
                     self._grow_table()
+                retry = True
                 continue
             if f_ovf:
                 self._grow_frontier()
+                retry = True
                 continue
             if r_ovf:
                 self._grow_route()
+                retry = True
                 continue
             if committed == 0:
                 break
@@ -1460,28 +1523,56 @@ class ShardedXlaChecker(Checker):
         if self._visitor is not None:
             self._visit_frontier()
 
+        retry = False  # re-running the level after an overflow recovery
         while True:
+            n_cached = len(self._step_cache)
             fn = self._superstep()
-            out = fn(
-                self._frontier,
-                self._frontier_ebits,
-                self._counts,
-                tuple(self._table),
-                self._disc_found,
-                self._disc_fp,
-            )
-            (nf, ne, ncounts, table, dfound, dfp, d_states, d_unique,
-             t_ovf, f_ovf, r_ovf, c_ovf, hv_w, hv_f, hv_c) = out
+            fresh = len(self._step_cache) > n_cached
+            run_rows = self._D * self._Fl
+            if self._heartbeat is not None:
+                self._heartbeat.beat(
+                    "dispatch", compile=fresh, bucket=run_rows,
+                    depth=self._depth, states=self._state_count,
+                )
+            with self._tracer.span(
+                "dispatch", flavor="single", bucket=run_rows,
+                cand=self._D * self._K, compile=fresh, retry=retry,
+                dedup=self._dedup, compaction="mesh", shards=self._D,
+            ) as _sp:
+                out = fn(
+                    self._frontier,
+                    self._frontier_ebits,
+                    self._counts,
+                    tuple(self._table),
+                    self._disc_found,
+                    self._disc_fp,
+                )
+                (nf, ne, ncounts, table, dfound, dfp, d_states, d_unique,
+                 t_ovf, f_ovf, r_ovf, c_ovf, hv_w, hv_f, hv_c) = out
+                committed = not (
+                    bool(np.asarray(t_ovf))
+                    or bool(np.asarray(f_ovf))
+                    or bool(np.asarray(r_ovf))
+                )
+                _sp.set(committed=int(committed))
+            self.dispatch_log.append((run_rows, int(committed)))
+            if self._heartbeat is not None:
+                self._heartbeat.commit(
+                    depth=self._depth, states=self._state_count
+                )
             if bool(np.asarray(c_ovf)):
                 self._raise_codec_overflow()
             if bool(np.asarray(t_ovf)):
                 self._grow_table()
+                retry = True
                 continue
             if bool(np.asarray(f_ovf)):
                 self._grow_frontier()
+                retry = True
                 continue
             if bool(np.asarray(r_ovf)):
                 self._grow_route()
+                retry = True
                 continue
             break
 
@@ -1544,6 +1635,50 @@ class ShardedXlaChecker(Checker):
 
     def max_depth(self) -> int:
         return self._max_depth
+
+    def metrics(self) -> Dict[str, Any]:
+        """The mesh engine's unified telemetry snapshot — same contract
+        as the single-chip ``XlaChecker.metrics()`` (stable key superset;
+        docs/observability.md) plus mesh gauges (``shards``, per-shard
+        capacities, route slots). Host-side reads only — frontier_count
+        is the cached total from the last engine-driven reduction, never
+        a fresh device dispatch (a poll from one process of a
+        multi-process mesh would desync SPMD program order)."""
+        import jax
+
+        cap = self._D * (
+            self._Cl + (self._delta_cap() if self._dedup == "delta" else 0)
+        )
+        return {
+            "engine": "xla-sharded",
+            "backend": jax.default_backend(),
+            # -- configuration gauges ---------------------------------
+            "dedup": self._dedup,
+            "compaction": "mesh",
+            "ladder": "none",
+            "cand_ladder_k": 1,
+            "shrink_exit": False,
+            "levels_per_dispatch": self._levels_per_dispatch,
+            "shards": self._D,
+            "frontier_rows_per_shard": self._Fl,
+            "table_slots_per_shard": self._Cl,
+            "route_slots": self._K,
+            # -- live search gauges -----------------------------------
+            "state_count": self._state_count,
+            "unique_state_count": self._unique_count,
+            "depth": self._depth,
+            "max_depth": self._max_depth,
+            "frontier_count": self._frontier_total_cache,
+            "frontier_capacity": self._D * self._Fl,
+            "table_capacity": cap,
+            "table_occupancy": self._unique_count / max(cap, 1),
+            "dispatches": len(self.dispatch_log),
+            "levels_committed": sum(c for _, c in self.dispatch_log),
+            "cand_retries": 0,
+            "hv": {},
+            # -- event counters (obs.Counters, pre-seeded) ------------
+            **self._counters.snapshot(),
+        }
 
     def is_done(self) -> bool:
         if self._exhausted or self._target_reached:

@@ -68,10 +68,22 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import obs
 from .checker.base import Checker
 from .checker.path import Path
 from .core import Expectation, Model
 from .ops import deltaset, fphash, hashset, sortedset
+
+#: Counter names every engine seeds (stable ``metrics()`` key set across
+#: dedup structures and runs that never grow; docs/observability.md).
+ENGINE_COUNTERS = (
+    "table_grows",
+    "frontier_grows",
+    "cand_grows",
+    "delta_flushes",
+    "shrink_exits",
+    "ladder_jumps",
+)
 
 
 #: The PackedModel protocol surface (module docstring above).
@@ -144,6 +156,8 @@ class XlaChecker(Checker):
         ladder: str = "auto",
         shrink_exit: str = "auto",
         cand_ladder: Any = "auto",
+        trace: Any = None,
+        heartbeat: Any = None,
     ):
         import jax
 
@@ -151,6 +165,13 @@ class XlaChecker(Checker):
         _require_packed(model)
         self._model = model
         self._jax = jax
+        # Observability (stateright_tpu/obs, docs/observability.md): a
+        # span tracer (NULL_TRACER when off — no clocks, no I/O), a
+        # heartbeat writer (None when off), and the event-counter half of
+        # metrics(). All host-side; never a device sync.
+        self._tracer = obs.resolve_tracer(trace)
+        self._heartbeat = obs.resolve_heartbeat(heartbeat)
+        self._counters = obs.Counters(ENGINE_COUNTERS)
         self._symmetry = builder._symmetry is not None
         if self._symmetry and not hasattr(model, "packed_representative"):
             raise TypeError(
@@ -474,10 +495,17 @@ class XlaChecker(Checker):
         # dispatch does not cost consumers (bench_detail.json) the
         # per-level breakdown.
         self.level_log: List[Dict[str, int]] = []
-        # Dispatch telemetry, both paths: (run_cap, committed_levels) per
-        # device call — makes the bucket ladder's choices (jump rungs,
-        # tail shrink-exits, lpd=1 snug picks) observable to tests and
-        # the superstep profiler.
+        # Dispatch telemetry — ONE shape for every engine and dispatch
+        # flavor (pinned by tests/test_obs.py, documented in
+        # docs/observability.md): one ``(run_cap, committed_levels)``
+        # tuple per device call, where ``committed_levels`` is the number
+        # of BFS levels that call committed. The one-level path therefore
+        # records 0 or 1 (0 = an overflow retry of the same level); a
+        # fused block records 0..levels_per_dispatch. Invariant on both:
+        # ``sum(committed for _, committed in dispatch_log) ==
+        # len(level_log)``. Makes the bucket ladder's choices (jump
+        # rungs, tail shrink-exits, lpd=1 snug picks) observable to
+        # tests, metrics(), and the superstep profiler.
         self.dispatch_log: List[Tuple[int, int]] = []
         # Host-verified-path telemetry (the sampled-predicate cliff,
         # VERDICT r4 weak #6): how much the conservative device predicate
@@ -1597,6 +1625,7 @@ class XlaChecker(Checker):
         return 1 << max(n - 1, 1).bit_length()
 
     def _grow_cand_cap(self, run_cap: int) -> None:
+        self._counters.inc("cand_grows")
         m = run_cap * self._A
         old = self._cand_cap_for(run_cap)
         new = min(old * 4, self._next_pow2(m))
@@ -1716,31 +1745,55 @@ class XlaChecker(Checker):
         total += self._ds.insert_lane_words(self._table, cand_w)
         return total
 
+    def _mark_dispatch_shape(self, program_key) -> bool:
+        """Whether THIS dispatch will trace + compile: true the first
+        time a given (program key, table capacity) pair is dispatched in
+        this process. A bare program-cache-miss check is not enough —
+        the jit cache keys on input avals, and the table capacity is the
+        one dispatch input whose SHAPE changes under a fixed program key
+        (an overflow-growth retry re-enters the same cached wrapper
+        with a doubled table, recompiling for minutes over the tunnel)
+        — and both consumers of the flag need it right: the heartbeat
+        watchdog's compile leash and roofline --measured's
+        compile-vs-steady stage split. Keyed on the program cache key's
+        CONTENT (not ``id(fn)`` — eviction by _grow_cand_cap can recycle
+        an address and mislabel a real compile) and tracked
+        model-shared, like the program cache itself."""
+        seen = self._model.__dict__.setdefault("_xla_dispatched_shapes", set())
+        key = (program_key, self._table.capacity)
+        fresh = key not in seen
+        seen.add(key)
+        return fresh
+
+    def _superstep_key(self, f_cap: int):
+        return (
+            f_cap, self._cand_cap_for(f_cap), self._symmetry,
+            self._max_probes, self._dedup, self._compaction,
+        )
+
     def _superstep_for(self, f_cap: int):
         import jax
 
-        cand_cap = self._cand_cap_for(f_cap)
-        key = (
-            f_cap, cand_cap, self._symmetry, self._max_probes, self._dedup,
-            self._compaction,
-        )
+        key = self._superstep_key(f_cap)
         fn = self._superstep_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._build_superstep(f_cap, cand_cap))
+            fn = jax.jit(self._build_superstep(f_cap, key[1]))
             self._superstep_cache[key] = fn
         return fn
+
+    def _fused_key(self, f_cap: int):
+        return (
+            "fused", f_cap, tuple(self._cand_rungs(f_cap)), self._symmetry,
+            self._max_probes, self._dedup, self._compaction,
+        )
 
     def _fused_for(self, f_cap: int):
         import jax
 
-        rungs = tuple(self._cand_rungs(f_cap))
-        key = (
-            "fused", f_cap, rungs, self._symmetry, self._max_probes,
-            self._dedup, self._compaction,
-        )
+        key = self._fused_key(f_cap)
         fn = self._superstep_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._build_fused(f_cap, rungs))
+            fn = jax.jit(self._build_fused(f_cap, key[2]))
             self._superstep_cache[key] = fn
         return fn
 
@@ -1773,8 +1826,11 @@ class XlaChecker(Checker):
         if self._dedup == "delta":
             ds = self._table
             if int(ds.n_delta) * 4 > ds.delta_capacity * 3:
-                flushed, ovf = deltaset.maintain_jit(ds)
-                if bool(ovf):  # pragma: no cover - load rule fires first
+                with self._tracer.span("delta_flush", proactive=True):
+                    flushed, ovf = deltaset.maintain_jit(ds)
+                    ovf = bool(ovf)
+                self._counters.inc("delta_flushes")
+                if ovf:  # pragma: no cover - load rule fires first
                     self._grow_table()
                 else:
                     self._table = flushed
@@ -1787,8 +1843,11 @@ class XlaChecker(Checker):
         runtime; see deltaset.insert) — and only an empty-delta overflow
         or a flush that cannot fit main grows capacity."""
         if self._dedup == "delta" and int(self._table.n_delta) > 0:
-            flushed, ovf = deltaset.maintain_jit(self._table)
-            if not bool(ovf):
+            with self._tracer.span("delta_flush", proactive=False):
+                flushed, ovf = deltaset.maintain_jit(self._table)
+                ovf = bool(ovf)
+            self._counters.inc("delta_flushes")
+            if not ovf:
                 self._table = flushed
                 return
         self._grow_table()
@@ -1801,29 +1860,36 @@ class XlaChecker(Checker):
         import jax.numpy as jnp
 
         old = self._table
-        if self._dedup == "delta":
-            # Growth folds the delta into a doubled main tier (host-side
-            # rebuild; rare by the load rule).
-            self._table = deltaset.grow(old, old.main_capacity * 2, jnp)
-        elif self._dedup == "sorted":
-            self._table = sortedset.grow(old, old.capacity * 2, jnp)
-        else:
-            occupied = (old.key_hi != 0) | (old.key_lo != 0)
-            bigger = hashset.make(old.capacity * 2, jnp)
-            bigger, _, ovf = jax.jit(hashset.insert, static_argnames="max_probes")(
-                bigger,
-                old.key_hi,
-                old.key_lo,
-                old.val_hi,
-                old.val_lo,
-                occupied,
-                max_probes=self._max_probes,
-            )
-            if bool(np.any(np.asarray(ovf))):  # pragma: no cover
-                raise RuntimeError(
-                    "rehash overflow — pathological fingerprint distribution"
+        with self._tracer.span(
+            "grow_table", dedup=self._dedup, capacity=old.capacity * 2
+        ):
+            if self._dedup == "delta":
+                # Growth folds the delta into a doubled main tier
+                # (host-side rebuild; rare by the load rule).
+                self._table = deltaset.grow(old, old.main_capacity * 2, jnp)
+            elif self._dedup == "sorted":
+                self._table = sortedset.grow(old, old.capacity * 2, jnp)
+            else:
+                occupied = (old.key_hi != 0) | (old.key_lo != 0)
+                bigger = hashset.make(old.capacity * 2, jnp)
+                bigger, _, ovf = jax.jit(
+                    hashset.insert, static_argnames="max_probes"
+                )(
+                    bigger,
+                    old.key_hi,
+                    old.key_lo,
+                    old.val_hi,
+                    old.val_lo,
+                    occupied,
+                    max_probes=self._max_probes,
                 )
-            self._table = bigger
+                if bool(np.any(np.asarray(ovf))):  # pragma: no cover
+                    raise RuntimeError(
+                        "rehash overflow — pathological fingerprint "
+                        "distribution"
+                    )
+                self._table = bigger
+        self._counters.inc("table_grows")
         self._model.__dict__[self._table_hint_key] = self._table.capacity
 
     def _raise_codec_overflow(self) -> None:
@@ -1889,8 +1955,10 @@ class XlaChecker(Checker):
         (exactly what ramp would have paid anyway), overshoot costs
         bounded padding. Measured on 2pc rm=8 widths this lands 3 compiled
         buckets instead of 8."""
+        self._counters.inc("frontier_grows")
         if run_cap < self._frontier_capacity:
-            nxt = min(run_cap * 4, self._frontier_capacity)
+            ramp = min(run_cap * 4, self._frontier_capacity)
+            nxt = ramp
             if self._ladder == "jump":
                 g = self._recent_growth()
                 if g is not None and g >= 2.0:
@@ -1899,6 +1967,8 @@ class XlaChecker(Checker):
                     while jump < 4 * est_peak:
                         jump *= 4
                     nxt = min(max(nxt, jump), self._frontier_capacity)
+            if nxt > ramp:
+                self._counters.inc("ladder_jumps")
             return nxt
         self._frontier_capacity *= 2
         self._model.__dict__["_xla_frontier_cap_hint"] = self._frontier_capacity
@@ -2003,6 +2073,7 @@ class XlaChecker(Checker):
         if self._target_max_depth is not None:
             budget_left = min(budget_left, self._target_max_depth - self._depth)
         run_cap = self._run_cap_for(self._frontier_count)
+        retry = False  # re-entering after an overflow recovery
         while budget_left > 0:
             # Keep the block's int32 generated-state accumulator safe.
             kmax = max(1, (2**31 - 1) // max(run_cap * self._A, 1))
@@ -2017,6 +2088,10 @@ class XlaChecker(Checker):
             )
             f_in, e_in = self._bucket_inputs(run_cap)
             fn = self._fused_for(run_cap)
+            # Will THIS call trace + compile? The dispatch span and the
+            # heartbeat phase carry the flag so watchdogs can tell a
+            # long compile from a wedge.
+            fresh = self._mark_dispatch_shape(self._fused_key(run_cap))
             # Shrink-exit threshold: the tail of a space collapses while
             # the fused loop is pinned to the peak bucket, paying the full
             # grid-compaction sort per level. If a smaller bucket already
@@ -2036,46 +2111,74 @@ class XlaChecker(Checker):
             prev2_gen = (
                 self.level_log[-2]["generated"] if len(self.level_log) > 1 else 0
             )
-            (
-                committed,
-                nf,
-                ne,
-                ncount,
-                table,
-                dfound,
-                dfp,
-                tot_states,
-                tot_unique,
-                ovf,
-                hv_w,
-                hv_f,
-                hv_c,
-                lvl_frontier,
-                lvl_states,
-                lvl_unique,
-                lvl_bucket,
-                lvl_cand,
-                _prev_gen,
-                _prev2_gen,
-                _force_full,
-                n_retries,
-            ) = fn(
-                f_in,
-                e_in,
-                self._frontier_count,
-                self._table,
-                self._disc_found,
-                self._disc_fp,
-                jnp.int32(budget),
-                jnp.int32(remaining),
-                jnp.asarray(host_found),
-                jnp.int32(shrink_below),
-                jnp.int32(min(prev_gen, 2**31 - 1)),
-                jnp.int32(min(prev2_gen, 2**31 - 1)),
-            )
-            # Commit the non-overflowing prefix of the block.
-            committed = int(committed)
+            if self._heartbeat is not None:
+                self._heartbeat.beat(
+                    "dispatch", compile=fresh, bucket=run_cap,
+                    depth=self._depth, states=self._state_count,
+                )
+            with self._tracer.span(
+                "dispatch", flavor="fused", bucket=run_cap,
+                # Attr expressions are evaluated even when the null span
+                # discards them — keep the off path allocation-free by
+                # gating the rung-list build on the tracer being live.
+                cand=(
+                    [list(r) for r in self._cand_rungs(run_cap)]
+                    if self._tracer.enabled
+                    else None
+                ),
+                compile=fresh, retry=retry, dedup=self._dedup,
+                compaction=self._compaction, shrink_below=shrink_below,
+            ) as _sp:
+                (
+                    committed,
+                    nf,
+                    ne,
+                    ncount,
+                    table,
+                    dfound,
+                    dfp,
+                    tot_states,
+                    tot_unique,
+                    ovf,
+                    hv_w,
+                    hv_f,
+                    hv_c,
+                    lvl_frontier,
+                    lvl_states,
+                    lvl_unique,
+                    lvl_bucket,
+                    lvl_cand,
+                    _prev_gen,
+                    _prev2_gen,
+                    _force_full,
+                    n_retries,
+                ) = fn(
+                    f_in,
+                    e_in,
+                    self._frontier_count,
+                    self._table,
+                    self._disc_found,
+                    self._disc_fp,
+                    jnp.int32(budget),
+                    jnp.int32(remaining),
+                    jnp.asarray(host_found),
+                    jnp.int32(shrink_below),
+                    jnp.int32(min(prev_gen, 2**31 - 1)),
+                    jnp.int32(min(prev2_gen, 2**31 - 1)),
+                )
+                # Commit the non-overflowing prefix of the block. The
+                # int() blocks until the device program finishes, so the
+                # span covers the whole round-trip — and reuses a sync
+                # the commit below needs anyway.
+                committed = int(committed)
+                _sp.set(committed=committed)
             self.dispatch_log.append((run_cap, committed))
+            if self._heartbeat is not None:
+                self._heartbeat.commit(
+                    depth=self._depth + committed,
+                    states=self._state_count + int(tot_states),
+                )
+            retry = False
             self._frontier, self._frontier_ebits, self._table = nf, ne, table
             self._frontier_count = int(ncount)
             self._disc_found, self._disc_fp = dfound, dfp
@@ -2131,12 +2234,15 @@ class XlaChecker(Checker):
                 # extra doubling is 2x memory AND a fresh shape compile).
                 if not grew_proactively:
                     self._resolve_table_overflow()
+                retry = True
                 continue
             if f_ovf:
                 run_cap = self._grow_frontier(run_cap)
+                retry = True
                 continue
             if cc_ovf:
                 self._grow_cand_cap(run_cap)
+                retry = True
                 continue
             if self._frontier_count == 0 or committed == 0:
                 break
@@ -2155,6 +2261,7 @@ class XlaChecker(Checker):
                 ]
                 if snug:
                     run_cap = min(snug)
+                    self._counters.inc("shrink_exits")
 
     def _run_block_single(self) -> None:
         """One BFS level per call (level-synchronous super-step)."""
@@ -2178,48 +2285,72 @@ class XlaChecker(Checker):
         # padded or sliced lazily to this level's bucket, so per-level cost
         # is O(run_cap), not O(frontier_capacity).
         run_cap = self._run_cap_for(self._frontier_count)
+        retry = False  # re-running the level after an overflow recovery
         while True:  # retried only on capacity growth
             f_in, e_in = self._bucket_inputs(run_cap)
             fn = self._superstep_for(run_cap)
-            out = fn(
-                f_in,
-                e_in,
-                self._frontier_count,
-                self._table,
-                self._disc_found,
-                self._disc_fp,
-            )
-            (
-                nf,
-                ne,
-                ncount,
-                table,
-                dfound,
-                dfp,
-                d_states,
-                d_unique,
-                t_ovf,
-                f_ovf,
-                c_ovf,
-                cc_ovf,
-                hv_words,
-                hv_fps,
-                hv_counts,
-            ) = out
-            committed = not (bool(t_ovf) or bool(f_ovf) or bool(cc_ovf))
+            fresh = self._mark_dispatch_shape(self._superstep_key(run_cap))
+            if self._heartbeat is not None:
+                self._heartbeat.beat(
+                    "dispatch", compile=fresh, bucket=run_cap,
+                    depth=self._depth, states=self._state_count,
+                )
+            with self._tracer.span(
+                "dispatch", flavor="single", bucket=run_cap,
+                cand=self._cand_cap_for(run_cap), compile=fresh,
+                retry=retry, dedup=self._dedup,
+                compaction=self._compaction,
+            ) as _sp:
+                out = fn(
+                    f_in,
+                    e_in,
+                    self._frontier_count,
+                    self._table,
+                    self._disc_found,
+                    self._disc_fp,
+                )
+                (
+                    nf,
+                    ne,
+                    ncount,
+                    table,
+                    dfound,
+                    dfp,
+                    d_states,
+                    d_unique,
+                    t_ovf,
+                    f_ovf,
+                    c_ovf,
+                    cc_ovf,
+                    hv_words,
+                    hv_fps,
+                    hv_counts,
+                ) = out
+                # The bool() reads block until the device program
+                # finishes — the span covers the full round-trip using
+                # syncs the commit logic pays anyway.
+                committed = not (bool(t_ovf) or bool(f_ovf) or bool(cc_ovf))
+                _sp.set(committed=int(committed))
             self.dispatch_log.append((run_cap, int(committed)))
+            if self._heartbeat is not None:
+                self._heartbeat.commit(
+                    depth=self._depth, states=self._state_count
+                )
             if bool(c_ovf):
                 self._raise_codec_overflow()
             if bool(t_ovf):
                 # Functional arrays: the pre-step table is untouched;
                 # flush (delta) or grow, then re-run the same level.
                 self._resolve_table_overflow()
+                retry = True
                 continue
             if bool(f_ovf):
                 run_cap = self._grow_frontier(run_cap)
+                retry = True
                 continue
             if bool(cc_ovf):
                 self._grow_cand_cap(run_cap)
+                retry = True
                 continue
             break
 
@@ -2263,39 +2394,56 @@ class XlaChecker(Checker):
         memoize per distinct history, so repeat candidates are cheap."""
         counts = np.asarray(hv_counts)
         words = fps = None
+        _sp = self._tracer.span("host_verify")
+        _checked0 = self.hv_stats["host_checked"]
+        _conf0 = self.hv_stats["confirmed"]
         t0 = time.monotonic()
-        for j, i in enumerate(self._hv_idx):
-            prop = self._properties[i]
-            if prop.name in self._found_names:
-                continue
-            n = int(counts[j])
-            if n == 0:
-                continue
-            self.hv_stats["flagged"] += n
-            if words is None:
-                words = np.asarray(hv_words)
-                fps = np.asarray(hv_fps)
-            confirmed = False
-            for r in range(min(n, self._hv_cap)):
-                state = self._model.unpack(words[j, r])
-                holds = bool(prop.condition(self._model, state))
-                viol = (not holds) if prop.expectation == Expectation.ALWAYS else holds
-                self.hv_stats["host_checked"] += 1
-                if viol:
-                    fp64 = (int(fps[j, r, 0]) << 32) | int(fps[j, r, 1])
-                    self._found_names[prop.name] = fp64
-                    confirmed = True
-                    self.hv_stats["confirmed"] += 1
-                    break
-                self.hv_stats["cleared"] += 1
-            if not confirmed and n > self._hv_cap:
-                raise RuntimeError(
-                    f"{n} candidate states for host-verified property "
-                    f"{prop.name!r} in one super-step, none of the first "
-                    f"{self._hv_cap} confirmed — tighten the conservative "
-                    "device predicate or raise the candidate cap."
+        with _sp:
+            try:
+                for j, i in enumerate(self._hv_idx):
+                    prop = self._properties[i]
+                    if prop.name in self._found_names:
+                        continue
+                    n = int(counts[j])
+                    if n == 0:
+                        continue
+                    self.hv_stats["flagged"] += n
+                    if words is None:
+                        words = np.asarray(hv_words)
+                        fps = np.asarray(hv_fps)
+                    confirmed = False
+                    for r in range(min(n, self._hv_cap)):
+                        state = self._model.unpack(words[j, r])
+                        holds = bool(prop.condition(self._model, state))
+                        viol = (
+                            (not holds)
+                            if prop.expectation == Expectation.ALWAYS
+                            else holds
+                        )
+                        self.hv_stats["host_checked"] += 1
+                        if viol:
+                            fp64 = (int(fps[j, r, 0]) << 32) | int(fps[j, r, 1])
+                            self._found_names[prop.name] = fp64
+                            confirmed = True
+                            self.hv_stats["confirmed"] += 1
+                            break
+                        self.hv_stats["cleared"] += 1
+                    if not confirmed and n > self._hv_cap:
+                        raise RuntimeError(
+                            f"{n} candidate states for host-verified property "
+                            f"{prop.name!r} in one super-step, none of the "
+                            f"first {self._hv_cap} confirmed — tighten the "
+                            "conservative device predicate or raise the "
+                            "candidate cap."
+                        )
+            finally:
+                # Inner finally: attrs land before the span's __exit__
+                # emits the line, including on the over-cap raise path.
+                _sp.set(
+                    checked=self.hv_stats["host_checked"] - _checked0,
+                    confirmed=self.hv_stats["confirmed"] - _conf0,
                 )
-        self.hv_stats["host_sec"] += time.monotonic() - t0
+                self.hv_stats["host_sec"] += time.monotonic() - t0
 
     def _visit_frontier(self) -> None:
         """Applies the visitor to every frontier state's path (the XLA
@@ -2335,6 +2483,42 @@ class XlaChecker(Checker):
 
     def max_depth(self) -> int:
         return self._max_depth
+
+    def metrics(self) -> Dict[str, Any]:
+        """One unified snapshot of the engine's telemetry (the registry
+        half of stateright_tpu/obs): configuration gauges, live search
+        gauges, and the event counters that used to live scattered across
+        ``cand_retries`` / ``dispatch_log`` / ad-hoc logs. Pure host-side
+        reads — safe to poll mid-run (the Explorer's ``/.status`` does).
+        Key set is stable across dedup structures (pinned by
+        tests/test_obs.py); schema in docs/observability.md."""
+        cap = self._table.capacity
+        return {
+            "engine": "xla",
+            "backend": self._jax.default_backend(),
+            # -- configuration gauges ---------------------------------
+            "dedup": self._dedup,
+            "compaction": self._compaction,
+            "ladder": self._ladder,
+            "cand_ladder_k": self._cand_ladder_k,
+            "shrink_exit": self._shrink_exit,
+            "levels_per_dispatch": self._levels_per_dispatch,
+            # -- live search gauges -----------------------------------
+            "state_count": self._state_count,
+            "unique_state_count": self._unique_count,
+            "depth": self._depth,
+            "max_depth": self._max_depth,
+            "frontier_count": self._frontier_count,
+            "frontier_capacity": self._frontier_capacity,
+            "table_capacity": cap,
+            "table_occupancy": self._unique_count / max(cap, 1),
+            "dispatches": len(self.dispatch_log),
+            "levels_committed": sum(c for _, c in self.dispatch_log),
+            "cand_retries": self.cand_retries,
+            "hv": dict(self.hv_stats),
+            # -- event counters (obs.Counters, pre-seeded) ------------
+            **self._counters.snapshot(),
+        }
 
     def is_done(self) -> bool:
         if self._exhausted or self._target_reached:

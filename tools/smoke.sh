@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Tier-0 smoke: a <5-minute subset to run BEFORE the ~50-minute full
+# suite — the observability schemas (trace/heartbeat/metrics/dispatch_log
+# consumers parse these), one fused-vs-single exactness pin (the engine's
+# semantic contract), and one packed-model end-to-end check. A red here
+# means don't bother starting the full run.
+#
+# Usage: tools/smoke.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec timeout -k 10 290 python -m pytest \
+  tests/test_obs.py \
+  tests/test_fused_dispatch.py::test_fused_matches_single_full_coverage \
+  tests/test_packed_increment.py \
+  -x -q -p no:cacheprovider "$@"

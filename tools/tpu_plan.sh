@@ -14,6 +14,7 @@
 #  5. soak             — device-scale full-coverage runs, stability-checked.
 set -u
 cd "$(dirname "$0")/.."
+mkdir -p runs
 log() { echo "[tpu_plan $(date +%H:%M:%S)] $*"; }
 
 log "stage 1: probe"
@@ -25,15 +26,15 @@ fi
 log "stage 2: full bench (the primary artifact)"
 python bench.py
 
-log "stage 3: microbench (results -> tpu_microbench.log)"
-timeout 1800 python tools/microbench.py 6 2>&1 | tee tpu_microbench.log
+log "stage 3: microbench (results -> runs/tpu_microbench.log)"
+timeout 1800 python tools/microbench.py 6 2>&1 | tee runs/tpu_microbench.log
 
 # (stage 4, the compiled-Pallas insert probe, ran 2026-07-31 and the kernel
 # failed to lower — tpu_pallas.log; kernel removed per the keep-or-kill rule.)
 
-log "stage 5: device-scale soak (results -> tpu_soak.log)"
+log "stage 5: device-scale soak (results -> runs/tpu_soak.log)"
 # Two runs per config: full-coverage counts must be stable run-to-run.
-timeout 3600 python - <<'EOF' 2>&1 | tee tpu_soak.log
+timeout 3600 python - <<'EOF' 2>&1 | tee runs/tpu_soak.log
 import os, time
 import jax
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(".jax_cache"))
@@ -80,4 +81,4 @@ soak("paxos 3c/3s", lambda: PackedPaxos(3, 3), budget_s=1200,
      frontier_capacity=1 << 19, table_capacity=1 << 25)
 EOF
 
-log "done; see BENCH output above, bench_detail.json, bench_probe.log, tpu_soak.log"
+log "done; see BENCH output above, runs/bench_detail.json, runs/bench_probe.log, runs/tpu_soak.log"

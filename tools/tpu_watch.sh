@@ -1,29 +1,191 @@
 #!/usr/bin/env bash
-# Background tunnel watcher: probe the axon TPU tunnel until it answers,
-# then run the staged measurement plan (tools/tpu_plan.sh). A plan run that
-# fails (tunnel dropped mid-way) goes back to probing; a successful plan
-# ends the watch. All output -> tpu_watch.log. Probes are cheap (one import
-# attempt under a 60s watchdog, every 4 min).
+# Tunnel watcher — the ONE parameterized replacement for the per-round
+# copies (tpu_watch_r3b/r4/r5/r5b/r5c/r5d/r5e.sh, now deleted): probe the
+# axon TPU tunnel until it answers, then run a staged measurement plan,
+# committing each stage's artifacts as it lands (a measurement that is not
+# in git did not happen — tunnel windows can be short).
+#
+# Usage:
+#   tools/tpu_watch.sh [-l LOG] [-m MARKDIR] [-s STALL_S] [-n] [STAGE...]
+#
+#   STAGE = "name,timeout_s,outfile,command ..."   (first 3 fields
+#           comma-separated; the rest is the command line, spaces fine)
+#   -l LOG      watch log                 (default runs/tpu_watch.log)
+#   -m MARKDIR  stage-done marker dir     (default runs/.watch_markers —
+#               reuse one dir across windows so finished stages stay
+#               finished; point different plans at different dirs)
+#   -s STALL_S  heartbeat stall leash, seconds (default 1500 — must
+#               out-wait a HEALTHY steady dispatch: a fused device call
+#               covers up to 32 BFS levels between beats. Deliberately
+#               LOOSER than bench.py's own BENCH_STALL_S=1200, so a
+#               bench stage's better-informed inner watchdog always
+#               fires first and this outer kill is the backstop)
+#   -n          do not git-commit stage artifacts
+#
+# With no stages, the default plan is a single stage running the staged
+# measurement script:  plan,7200,runs/tpu_plan.log,bash tools/tpu_plan.sh
+#
+# Wedge detection is HEARTBEAT-AWARE (stateright_tpu/obs/heartbeat.py,
+# docs/observability.md): every stage runs with STPU_HEARTBEAT pointed at
+# a per-stage file the engines rewrite around each device dispatch. A
+# beat stale past STALL_S while the engine is mid-dispatch is a wedged
+# tunnel — the stage is killed immediately instead of idling out its full
+# hard timeout; a beat flagged compile=true gets a 3x leash (XLA compiles
+# over the tunnel legitimately run minutes). Stages that never beat
+# (non-engine tools) fall back to the hard timeout alone.
+#
+# Example (a bench A/B plus a profile pass):
+#   tools/tpu_watch.sh \
+#     "bench_jump,2400,runs/bench_jump.json,env BENCH_LADDER=jump python bench.py" \
+#     "bench_ramp,2400,runs/bench_ramp.json,env BENCH_LADDER=ramp python bench.py" \
+#     "profile,2700,runs/profile.log,python tools/profile_superstep.py 8"
 set -u
 cd "$(dirname "$0")/.."
-LOG=tpu_watch.log
-log() { echo "[tpu_watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
 
-log "watcher started (pid $$)"
-attempt=0
+LOG=runs/tpu_watch.log
+MARK=runs/.watch_markers
+STALL_S=1500
+COMMIT=1
+while getopts "l:m:s:n" opt; do
+  case "$opt" in
+    l) LOG=$OPTARG ;;
+    m) MARK=$OPTARG ;;
+    s) STALL_S=$OPTARG ;;
+    n) COMMIT=0 ;;
+    *) exit 2 ;;
+  esac
+done
+shift $((OPTIND - 1))
+
+STAGES=("$@")
+if [ ${#STAGES[@]} -eq 0 ]; then
+  STAGES=("plan,7200,runs/tpu_plan.log,bash tools/tpu_plan.sh")
+fi
+
+mkdir -p runs "$MARK"
+log() { echo "[tpu_watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
+probe() { timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; }
+done_p() { [ -f "$MARK/$1" ]; }
+mark() { touch "$MARK/$1"; }
+
+commit_stage() {
+  [ "$COMMIT" -eq 1 ] || return 0
+  local msg=$1 f; shift
+  local have=()
+  for f in "$@" "$LOG"; do
+    [ -e "$f" ] && have+=("$f") || log "artifact missing: $f"
+  done
+  [ ${#have[@]} -gt 0 ] || return 0
+  git add -f -- "${have[@]}" >>"$LOG" 2>&1
+  # Pathspec-limited: a stage commit must carry ONLY its artifacts —
+  # never whatever else happens to be sitting in the index.
+  git commit -q -m "$msg" -- "${have[@]}" >>"$LOG" 2>&1 && log "committed: $msg"
+}
+
+# hb_stale FILE START_EPOCH — rc 0 (kill it) when the stage's heartbeat
+# exists, postdates the stage start, and is stale past its leash WHILE
+# the engine is mid-dispatch. Stale in phase="idle" is host-side work
+# (audits, witness reconstruction), not the tunnel — the hard timeout
+# governs there, per the protocol (docs/observability.md).
+hb_stale() {
+  python - "$1" "$2" "$STALL_S" <<'EOF'
+import json, os, sys, time
+path, start, stall = sys.argv[1], float(sys.argv[2]), float(sys.argv[3])
+try:
+    mtime = os.stat(path).st_mtime
+except OSError:
+    sys.exit(1)  # no beat yet: hard timeout governs
+if mtime < start:
+    sys.exit(1)  # a previous run's file
+age = time.time() - mtime
+try:
+    rec = json.load(open(path))
+except Exception:
+    rec = {}
+if rec.get("phase") != "dispatch":
+    sys.exit(1)  # host-side work: not a tunnel wedge
+allow = stall * (3 if rec.get("compile") else 1)
+sys.exit(0 if age > allow else 1)
+EOF
+}
+
+# run_stage NAME TIMEOUT OUT CMD... — marker on rc==0; bench.py stages
+# additionally need a tpu JSON line (bench.py silently falls back to a
+# cpu worker otherwise). Returns 1 when the tunnel dropped (re-probe).
+run_stage() {
+  local name=$1 tmo=$2 out=$3; shift 3
+  done_p "$name" && { log "skip $name (done)"; return 0; }
+  probe || { log "tunnel down before $name; back to wait"; return 1; }
+  local hb="runs/heartbeat.$name.json"
+  local start; start=$(date +%s)
+  log "stage $name (timeout ${tmo}s, stall ${STALL_S}s): $*"
+  # setsid: the stage leads its own process group, so a kill takes the
+  # whole tree — bench.py's worker grandchild must not survive holding
+  # the device (and beating the heartbeat) after its parent dies.
+  STPU_HEARTBEAT="$hb" setsid "$@" >"$out" 2>&1 &
+  local pid=$!
+  local rc=""
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 15
+    if [ $(($(date +%s) - start)) -ge "$tmo" ]; then
+      log "$name: hard timeout ${tmo}s; killing group"
+      kill -- -"$pid" 2>/dev/null; sleep 2; kill -9 -- -"$pid" 2>/dev/null
+      rc=124; break
+    fi
+    if hb_stale "$hb" "$start"; then
+      log "$name: heartbeat stale mid-dispatch (wedged tunnel); killing group"
+      kill -- -"$pid" 2>/dev/null; sleep 2; kill -9 -- -"$pid" 2>/dev/null
+      rc=125; break
+    fi
+  done
+  if [ -z "$rc" ]; then wait "$pid"; rc=$?; fi
+  log "$name rc=$rc: $(tail -c 250 "$out" 2>/dev/null)"
+  case "$*" in
+    *bench.py*)
+      # The marker needs the REAL backend, not the worker label: the
+      # axon plugin can probe ok while yielding a CPU device, and a
+      # tpu-labeled line banking CPU numbers must not finish the stage
+      # (the reason bench.py's primary line carries "backend").
+      [ "$rc" -eq 0 ] && grep -q '"backend": "tpu"' "$out" && mark "$name"
+      # The per-level detail is the analysis artifact; a bench number
+      # without it is half a measurement (every prior watcher committed
+      # these two with the stage, by force past the runs/* ignore).
+      commit_stage "TPU watch $name (rc=$rc)" "$out" \
+        runs/bench_detail.json runs/bench_probe.log
+      ;;
+    *)
+      [ "$rc" -eq 0 ] && mark "$name"
+      commit_stage "TPU watch $name (rc=$rc)" "$out"
+      ;;
+  esac
+  return 0
+}
+
+all_done() {
+  local s name
+  for s in "${STAGES[@]}"; do
+    IFS=, read -r name _ <<<"$s"
+    done_p "$name" || return 1
+  done
+  return 0
+}
+
+log "watcher started (pid $$, ${#STAGES[@]} stages, stall ${STALL_S}s)"
 while true; do
-  attempt=$((attempt + 1))
-  if timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; then
-    log "probe $attempt: TUNNEL UP — launching tpu_plan.sh"
-    bash tools/tpu_plan.sh >>"$LOG" 2>&1
-    rc=$?
-    log "tpu_plan.sh finished rc=$rc"
-    if [ "$rc" -eq 0 ]; then
+  if probe; then
+    log "TUNNEL UP — staged pass"
+    for s in "${STAGES[@]}"; do
+      IFS=, read -r name tmo out cmd <<<"$s"
+      # shellcheck disable=SC2086 — the command line is intentionally split
+      run_stage "$name" "$tmo" "$out" $cmd || break
+    done
+    if all_done; then
+      log "all stages done; watcher exiting"
       exit 0
     fi
-    log "plan failed; resuming probe loop"
+    log "pass finished with unfinished stages; resuming watch"
   else
-    log "probe $attempt: tunnel down"
+    log "tunnel down"
   fi
   sleep 240
 done
