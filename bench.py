@@ -17,17 +17,22 @@ north-star of 50M states/sec (BASELINE.md).
 **Hang-proofing**: the axon TPU tunnel can WEDGE — not fail — at any point
 (observed: ``jax.devices()`` blocking forever, and a dispatch mid-run
 blocking after a successful probe). All device work therefore runs in a
-child process under a watchdog that is **heartbeat-aware** (the obs layer,
-docs/observability.md): the worker's engines rewrite
+child process under the **heartbeat-aware watchdog** of
+``stateright_tpu/supervise.py`` (the library form of what used to live
+here; the obs layer, docs/observability.md): the worker's engines rewrite
 ``runs/heartbeat.json`` around every device dispatch, so the parent kills
 on *staleness in-band* — a worker mid-``phase="dispatch"`` whose beat goes
 stale past ``BENCH_STALL_S`` is a wedged tunnel (the leash stretches 3x
 when the beat says the dispatch carries a fresh XLA compile), while a
 beating worker may run to the hard ``BENCH_WORKER_TIMEOUT_S`` cap.
-``BENCH_TPU_RETRIES`` retries follow (the persistent compile cache makes
-retries cheap); only after the retries are spent does the harness fall
-back to a CPU child. Probe diagnostics and per-pass progress go to stderr
-and ``runs/bench_probe.log`` so a hang is attributable post-mortem.
+``BENCH_TPU_RETRIES`` retries follow — each retry RESUMES from the latest
+valid checkpoint the killed worker auto-wrote (``BENCH_CHECKPOINT=0``
+disables; ``BENCH_CHECKPOINT_EVERY`` sets the cadence, default 60s), so a
+wedge costs at most one checkpoint interval, not the whole search — and
+the persistent compile cache makes the respawn cheap. Only after the
+retries are spent does the harness fall back to a CPU child. Probe
+diagnostics and per-pass progress go to stderr and
+``runs/bench_probe.log`` so a hang is attributable post-mortem.
 
 Per-level timing detail is written to ``runs/bench_detail.json`` (levels,
 frontier widths, per-level seconds, compile vs steady split) for the
@@ -49,6 +54,10 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # Fresh run artifacts (detail JSON, probe log, heartbeat, traces) land
 # under runs/ — the repo root stays clean (.gitignore rules match).
 RUNS = os.path.join(REPO, "runs")
+# Auto-checkpoint bases for the primary passes (rotated .npz files; the
+# worker resumes from the latest VALID rotation after a watchdog kill).
+CK_WARM = os.path.join(RUNS, "bench_ck_warm.npz")
+CK_MEASURED = os.path.join(RUNS, "bench_ck_measured.npz")
 
 # Pinned full-coverage (generated, unique) counts. Exact counts are the
 # product guarantee (the reference asserts them in its example tests, e.g.
@@ -140,16 +149,21 @@ def _tpu_available(timeout_s: int) -> bool:
 def _run_check(model, detail: list | None, budget_s: float = float("inf"), **spawn_kwargs):
     """A check bounded by wall-clock ``budget_s``: runs whole dispatch
     blocks until done or out of budget; returns (generated_states, seconds,
-    checker, completed). The budget means an arbitrarily large ``BENCH_RM``
-    space still yields a steady-state number in bounded time."""
-    # Deliberately IDENTICAL spawn kwargs for the warm and measured passes
-    # (the learned-capacity hints are NOT merged in): every grown capacity
-    # changes array shapes, so a measured pass spawned at the warm pass's
-    # grown capacities re-traces every bucket program — paying minutes of
-    # XLA compile to save a millisecond rehash. With identical kwargs the
-    # measured pass replays the warm schedule (including the same proactive
-    # growth points) and hits the compile cache at every step.
+    checker, completed, states_at_start). The budget means an arbitrarily
+    large ``BENCH_RM`` space still yields a steady-state number in bounded
+    time. ``states_at_start`` is nonzero only on a checkpoint resume — the
+    throughput numerator is the states generated by THIS process."""
+    # Deliberately IDENTICAL capacity kwargs for the warm and measured
+    # passes (the learned-capacity hints are NOT merged in): every grown
+    # capacity changes array shapes, so a measured pass spawned at the warm
+    # pass's grown capacities re-traces every bucket program — paying
+    # minutes of XLA compile to save a millisecond rehash. With identical
+    # kwargs the measured pass replays the warm schedule (including the
+    # same proactive growth points) and hits the compile cache at every
+    # step. (checkpoint_to/checkpoint_every ride along freely: they change
+    # no array shapes.)
     checker = model.checker().spawn_xla(**spawn_kwargs)
+    states0 = checker.state_count() if spawn_kwargs.get("checkpoint") else 0
     t0 = time.monotonic()
     while not checker.is_done():
         if time.monotonic() - t0 > budget_s:
@@ -177,7 +191,7 @@ def _run_check(model, detail: list | None, budget_s: float = float("inf"), **spa
         checker.assert_properties()
     # state_count() includes init states (the reference's reporter counts
     # them too, report.rs:66-73) — generated >= unique at every scale.
-    return checker.state_count(), elapsed, checker, completed
+    return checker.state_count(), elapsed, checker, completed, states0
 
 
 def _run_matrix(platform: str) -> list:
@@ -233,7 +247,7 @@ def _run_matrix(platform: str) -> list:
             t0 = time.monotonic()
             _run_check(model, None, budget_s=budget, **kwargs)  # warm: compiles
             warm = time.monotonic() - t0
-            states, sec, checker, done = _run_check(
+            states, sec, checker, done, _ = _run_check(
                 model, None, budget_s=budget, **kwargs
             )
             if not done:
@@ -356,20 +370,111 @@ def _worker(platform: str) -> None:
     # off, lane_words all 0) while bench_detail.json claims sorted.
     # Pinning keeps the artifact truthful to its label on every backend.
     spawn_kwargs["dedup"] = effective_dedup
-    warm_states, warm_sec, _, _ = _run_check(
-        model, None, budget_s=warm_budget, **spawn_kwargs
-    )
-    _log(f"warm pass: {warm_states} states in {warm_sec:.2f}s (compile included)")
 
-    detail: list = []
-    states, elapsed, checker, completed = _run_check(
-        model, detail, budget_s=measure_budget, **spawn_kwargs
+    # Crash recovery (stateright_tpu/checkpoint.py + supervise.py): the
+    # primary passes auto-checkpoint to rotated files under runs/, and a
+    # RELAUNCHED worker (the parent's watchdog killed a wedged
+    # predecessor) resumes from the latest valid rotation instead of
+    # restarting from level 0 — the parent clears stale rotations at the
+    # start of every bench invocation, so an on-disk checkpoint always
+    # belongs to THIS bench run. A measured-pass checkpoint wins (the warm
+    # compiles are already banked in .jax_cache); a warm one resumes the
+    # warm pass. Validation guards the CPU fallback: its smaller
+    # BENCH_CPU_RM model must not resume a checkpoint of a different
+    # configuration.
+    from stateright_tpu.checkpoint import (
+        latest_valid_checkpoint,
+        validate_model,
     )
-    value = states / max(elapsed, 1e-9)
+
+    checkpointing = os.environ.get("BENCH_CHECKPOINT", "1") != "0"
+    ck_every = os.environ.get("BENCH_CHECKPOINT_EVERY", "60s")
+    prop_names = [p.name for p in model.properties()]
+
+    def _valid_resume(base, skip_completed=False):
+        # with_meta: validation already paid the decompress+digest pass —
+        # at soak-scale tables a second load_checkpoint here costs minutes.
+        path, meta = latest_valid_checkpoint(base, with_meta=True)
+        if path is None:
+            return None, None
+        try:
+            validate_model(meta, model, prop_names)
+            # Every v3 checkpoint writes "done" (wider than the
+            # exhausted/target_reached flags — see checkpoint.py); a v3
+            # file without it is malformed and lands in the except arm.
+            done = meta["done"]
+        except Exception as e:
+            _log(f"not resuming from {path}: {type(e).__name__}: {e}")
+            return None, None
+        if skip_completed and done:
+            # A COMPLETED measured pass whose primary line never made it
+            # out (killed in the gap before printing): resuming it would
+            # measure zero work. Fall back to the warm checkpoint — a
+            # completed warm resume is instant and the measured pass
+            # re-runs fresh, yielding a real number.
+            _log(f"not resuming from {path}: already-completed run")
+            return None, None
+        return path, meta
+
+    resumed_from = resume_phase = resume_meta = None
+    if checkpointing:
+        resumed_from, resume_meta = _valid_resume(CK_MEASURED, skip_completed=True)
+        if resumed_from is not None:
+            resume_phase = "measured"
+        else:
+            resumed_from, resume_meta = _valid_resume(CK_WARM)
+            if resumed_from is not None:
+                resume_phase = "warm"
+
+    def _ck_kwargs(base):
+        if not checkpointing:
+            return {}
+        return dict(
+            checkpoint_to=base, checkpoint_every=ck_every, checkpoint_keep=3
+        )
+
+    if resume_phase == "measured":
+        # The wedge hit mid-measurement: skip the warm pass (its compiles
+        # are on disk) and continue the measured pass where it left off.
+        _log(
+            f"resuming measured pass from {resumed_from} "
+            f"(depth {resume_meta['depth']}, "
+            f"{resume_meta['state_count']} states); warm pass skipped"
+        )
+        warm_states, warm_sec = 0, 0.0
+    else:
+        wkw = dict(spawn_kwargs, **_ck_kwargs(CK_WARM))
+        if resume_phase == "warm":
+            _log(
+                f"resuming warm pass from {resumed_from} "
+                f"(depth {resume_meta['depth']})"
+            )
+            wkw["checkpoint"] = resumed_from
+        warm_states, warm_sec, _, _, _ = _run_check(
+            model, None, budget_s=warm_budget, **wkw
+        )
+        _log(
+            f"warm pass: {warm_states} states in {warm_sec:.2f}s "
+            "(compile included)"
+        )
+
+    mkw = dict(spawn_kwargs, **_ck_kwargs(CK_MEASURED))
+    if resume_phase == "measured":
+        mkw["checkpoint"] = resumed_from
+    detail: list = []
+    states, elapsed, checker, completed, states0 = _run_check(
+        model, detail, budget_s=measure_budget, **mkw
+    )
+    value = (states - states0) / max(elapsed, 1e-9)
+    resumed_note = (
+        f", resumed at depth {resume_meta['depth']}"
+        if resume_phase == "measured"
+        else ""
+    )
     _log(
         f"measured pass: {states} states ({checker.unique_state_count()} unique, "
         f"depth {checker.max_depth()}, {'full' if completed else 'partial'} "
-        f"coverage) in {elapsed:.2f}s -> {value:,.0f} states/s"
+        f"coverage{resumed_note}) in {elapsed:.2f}s -> {value:,.0f} states/s"
     )
     # Exact-count self-check (pure host arithmetic — safe before the
     # primary print; only full coverage pins the totals). The table AUDIT
@@ -399,6 +504,11 @@ def _worker(platform: str) -> None:
                 # chip-labeled row banking CPU numbers poisons the A/B
                 # record (same convention as tools/cand_ab.py).
                 "backend": jax.default_backend(),
+                # Resume provenance: a resumed line measures the tail of a
+                # space from a checkpoint, not a cold full pass — it must
+                # be distinguishable at a glance (detail in
+                # bench_detail.json's "resume" dict).
+                "resumed": resume_phase,
             }
         ),
         flush=True,
@@ -455,6 +565,22 @@ def _worker(platform: str) -> None:
                     "cand_ladder": checker._cand_ladder_k,
                     "cand_retries": checker.cand_retries,
                     "lane_words_per_level": lane_summary,
+                    # Resume provenance: which checkpoint (if any) this
+                    # worker resumed from, which pass it belonged to, and
+                    # the attempt index the parent stamped. levels_replayed
+                    # is 0 by construction — a resume starts AT the
+                    # checkpoint's depth; nothing before it re-runs (the
+                    # alternative, a level-0 restart, replays everything).
+                    "resume": {
+                        "resumed_from": resumed_from,
+                        "phase": resume_phase,
+                        "attempt": int(os.environ.get("BENCH_ATTEMPT", "0")),
+                        "resume_depth": (
+                            resume_meta["depth"] if resume_meta else None
+                        ),
+                        "states_at_resume": states0,
+                        "levels_replayed": 0,
+                    },
                     "generated_states": states,
                     "unique_states": checker.unique_state_count(),
                     "max_depth": checker.max_depth(),
@@ -490,39 +616,33 @@ def _json_lines(text) -> list:
     return [l for l in (text or "").splitlines() if l.strip().startswith("{")]
 
 
-def _hb_read(path: str) -> dict | None:
-    """Parsed heartbeat, or None (inline stdlib read — the parent stays
-    free of package imports; schema: stateright_tpu/obs/heartbeat.py)."""
-    try:
-        with open(path) as fh:
-            return json.load(fh)
-    except (OSError, ValueError):
-        return None
-
-
-def _spawn_worker(platform: str, timeout_s: float) -> str | None:
+def _spawn_worker(platform: str, timeout_s: float, attempt: int = 0) -> str | None:
     """Runs ``bench.py --worker <platform>`` under the heartbeat-aware
-    watchdog; returns the worker's primary JSON line or None.
+    watchdog of ``stateright_tpu/supervise.py`` (the generalized library
+    form of the loop that used to live here — bench holds NO watchdog
+    logic of its own); returns the worker's primary JSON line or None.
 
     The worker's engines rewrite the heartbeat file around every device
-    dispatch (STPU_HEARTBEAT, injected here unless BENCH_HEARTBEAT=0), so
-    the parent distinguishes in-band instead of guessing from one hard
-    timeout: a stale beat in ``phase="dispatch"`` is a wedged tunnel
-    (leash ``BENCH_STALL_S``, stretched 3x when the beat flags an XLA
-    compile); a worker that never beats gets ``BENCH_STARTUP_GRACE_S``
-    (imports + init inserts can wedge before the first dispatch); a
-    beating worker may run to the hard ``timeout_s`` cap. A worker killed
-    mid-matrix still counts as success if it printed the primary line
-    first. The worker's stderr streams to ours (it logs to
-    runs/bench_probe.log)."""
+    dispatch (STPU_HEARTBEAT, injected by run_worker unless
+    BENCH_HEARTBEAT=0), so the watchdog distinguishes in-band instead of
+    guessing from one hard timeout: a stale beat in ``phase="dispatch"``
+    is a wedged tunnel (leash ``BENCH_STALL_S``, stretched 3x when the
+    beat flags an XLA compile); a worker that never beats gets
+    ``BENCH_STARTUP_GRACE_S`` (imports + init inserts can wedge before the
+    first dispatch); a beating worker may run to the hard ``timeout_s``
+    cap. A worker killed mid-matrix still counts as success if it printed
+    the primary line first (stdout salvage below). ``attempt`` is stamped
+    into the worker env as BENCH_ATTEMPT for resume provenance."""
+    from stateright_tpu import supervise as sup
+
     os.makedirs(RUNS, exist_ok=True)
     env = dict(os.environ)
+    env["BENCH_ATTEMPT"] = str(attempt)
     hb_path = None
-    if os.environ.get("BENCH_HEARTBEAT", "1") != "0":
+    if platform != "cpu" and os.environ.get("BENCH_HEARTBEAT", "1") != "0":
         hb_path = os.environ.get("STPU_HEARTBEAT") or os.path.join(
             RUNS, "heartbeat.json"
         )
-        env["STPU_HEARTBEAT"] = hb_path
     if platform == "cpu":
         # No tunnel, no wedge: the staleness kill exists for the axon
         # transport, and on this 1-core box a long steady dispatch is
@@ -530,116 +650,66 @@ def _spawn_worker(platform: str, timeout_s: float) -> str | None:
         # Popped from the child env too: an outer watcher
         # (tools/tpu_watch.sh) supervising the same heartbeat path must
         # not see CPU-paced dispatch beats and kill the fallback run.
-        hb_path = None
         env.pop("STPU_HEARTBEAT", None)
-    # The leash must out-wait a HEALTHY steady dispatch: a fused device
-    # call covers up to levels_per_dispatch=32 BFS levels with no beat in
-    # between, which at soak scale legitimately runs many minutes.
-    stall_s = float(os.environ.get("BENCH_STALL_S", "1200"))
-    startup_grace_s = float(os.environ.get("BENCH_STARTUP_GRACE_S", "900"))
-    t0 = time.monotonic()
-    wall0 = time.time()  # beats older than this are a previous run's
-    # Worker stdout goes to a file, not a pipe: the parent never reads
-    # concurrently, so a pipe could deadlock a chatty worker; a file also
-    # survives for post-mortem salvage no matter how the worker dies.
-    stdout_path = os.path.join(RUNS, f"worker_{platform}.out")
-    stdout_fh = open(stdout_path, "w")
-    proc = subprocess.Popen(
+    res = sup.run_worker(
         [sys.executable, os.path.abspath(__file__), "--worker", platform],
-        stdout=stdout_fh,
-        text=True,
-        cwd=REPO,
+        heartbeat=hb_path,
+        timeout_s=timeout_s,
+        # The leash must out-wait a HEALTHY steady dispatch: a fused
+        # device call covers up to levels_per_dispatch=32 BFS levels with
+        # no beat in between, which at soak scale legitimately runs many
+        # minutes.
+        stall_s=float(os.environ.get("BENCH_STALL_S", "1200")),
+        startup_grace_s=float(os.environ.get("BENCH_STARTUP_GRACE_S", "900")),
         env=env,
+        cwd=REPO,
+        # Worker stdout goes to a file, not a pipe: the parent never reads
+        # concurrently, so a pipe could deadlock a chatty worker; a file
+        # also survives for post-mortem salvage no matter how the worker
+        # dies.
+        stdout_path=os.path.join(RUNS, f"worker_{platform}.out"),
+        log=_log,
     )
-    killed = None
-    while True:
-        try:
-            proc.wait(timeout=5)
-            break
-        except subprocess.TimeoutExpired:
-            pass
-        elapsed = time.monotonic() - t0
-        if elapsed > timeout_s:
-            killed = f"hard timeout {timeout_s:.0f}s"
-            break
-        if hb_path is None:
-            continue
-        try:
-            mtime = os.stat(hb_path).st_mtime
-        except OSError:
-            mtime = None
-        if mtime is None or mtime < wall0:
-            # No beat from THIS worker yet: startup (jax import, model
-            # build, init inserts) gets its own grace, then counts as a
-            # pre-dispatch wedge.
-            if elapsed > startup_grace_s:
-                killed = f"no heartbeat within {startup_grace_s:.0f}s startup grace"
-                break
-            continue
-        age = time.time() - mtime
-        rec = _hb_read(hb_path) or {}
-        if rec.get("phase") != "dispatch":
-            # Stale in phase="idle" is HOST-side work (audit readbacks,
-            # matrix model builds, witness reconstruction), not the
-            # tunnel — the protocol says leave it alone (a dead process
-            # is caught by proc.wait above, a runaway host loop by the
-            # hard timeout).
-            continue
-        allow = stall_s * (3 if rec.get("compile") else 1)
-        if age > allow:
-            killed = (
-                f"heartbeat stale {age:.0f}s > {allow:.0f}s mid-dispatch "
-                f"(compile={bool(rec.get('compile'))}, "
-                f"seq={rec.get('seq', '?')}) — wedged tunnel"
-            )
-            break
-    def _clear_heartbeat():
-        # The heartbeat is LIVE supervision state, not an artifact: once
-        # this worker is gone its file must not linger — a dead worker's
-        # final phase="dispatch" beat would read as a wedged tunnel to an
-        # outer watcher (tools/tpu_watch.sh) and get the stage's whole
-        # process group killed while a retry / CPU fallback is healthy.
-        if hb_path:
-            try:
-                os.unlink(hb_path)
-            except OSError:
-                pass
-
-    if killed is not None:
-        proc.kill()
-        proc.wait()
-        _clear_heartbeat()
-        stdout_fh.close()
-        with open(stdout_path) as fh:
-            salvage = _json_lines(fh.read())
-        if salvage:
+    with open(res.stdout_path) as fh:
+        lines = _json_lines(fh.read())
+    if res.killed is not None:
+        if lines:
             _log(
-                f"{platform} worker killed ({killed}) but the primary "
+                f"{platform} worker killed ({res.killed}) but the primary "
                 "metric was already out; using it"
             )
-            return salvage[0]
-        _log(f"{platform} worker killed: {killed}")
+            return lines[0]
+        _log(f"{platform} worker killed: {res.killed}")
         return None
-    _clear_heartbeat()
-    stdout_fh.close()
-    with open(stdout_path) as fh:
-        out = fh.read()
-    dt = time.monotonic() - t0
-    lines = _json_lines(out)
     if not lines:
-        _log(f"{platform} worker rc={proc.returncode} in {dt:.0f}s, no JSON line")
+        _log(f"{platform} worker rc={res.rc} in {res.seconds:.0f}s, no JSON line")
         return None
-    if proc.returncode != 0:
+    if res.rc != 0:
         # Died (wedged mid-matrix and externally terminated, OOM, ...)
         # AFTER the primary metric went out: the measurement happened —
         # use it, exactly like the watchdog salvage above.
         _log(
-            f"{platform} worker rc={proc.returncode} in {dt:.0f}s but the "
+            f"{platform} worker rc={res.rc} in {res.seconds:.0f}s but the "
             "primary metric was already out; using it"
         )
         return lines[0]
-    _log(f"{platform} worker ok in {dt:.0f}s")
+    _log(f"{platform} worker ok in {res.seconds:.0f}s")
     return lines[0]
+
+
+def _clear_checkpoints() -> None:
+    """A fresh bench invocation must not resume a PREVIOUS invocation's
+    checkpoints: clear every rotation of both bases up front, so an
+    on-disk checkpoint always means 'written by this run's earlier
+    attempt'."""
+    from stateright_tpu.checkpoint import rotations
+
+    for base in (CK_WARM, CK_MEASURED):
+        for path in rotations(base):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 def main() -> None:
@@ -652,12 +722,16 @@ def main() -> None:
     worker_timeout = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", "2400"))
     retries = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
 
+    _clear_checkpoints()
     line = None
     if _tpu_available(probe_s):
         for attempt in range(1 + retries):
             if attempt:
-                _log(f"TPU retry {attempt}/{retries} (compile cache warm)")
-            line = _spawn_worker("tpu", worker_timeout)
+                _log(
+                    f"TPU retry {attempt}/{retries} (compile cache warm; "
+                    "resuming from the latest valid checkpoint, not level 0)"
+                )
+            line = _spawn_worker("tpu", worker_timeout, attempt=attempt)
             if line is not None:
                 break
     else:

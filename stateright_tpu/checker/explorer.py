@@ -103,6 +103,11 @@ class ExplorerApp:
                 # (occupancy, dispatch/growth counters); host backends
                 # report the base counters.
                 "metrics": checker.metrics(),
+                # Recovery state: the last auto/manual checkpoint this
+                # checker wrote ({path, depth, states, unique, unix_ts}),
+                # or None — so a wedged interactive session is diagnosable
+                # (and resumable) from the outside.
+                "last_checkpoint": getattr(checker, "_last_checkpoint", None),
             }
 
     def run_to_completion(self) -> None:

@@ -76,6 +76,9 @@ class ShardedXlaChecker(Checker):
         visit_cap: int = 4096,
         levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
+        checkpoint_to: Optional[str] = None,
+        checkpoint_every: Any = None,
+        checkpoint_keep: Optional[int] = None,
         dedup: str = "auto",
         host_verified_cap: int = 128,
         trace=None,
@@ -204,10 +207,22 @@ class ShardedXlaChecker(Checker):
         self._heartbeat = obs.resolve_heartbeat(heartbeat)
         self._counters = obs.Counters(ENGINE_COUNTERS + ("route_grows",))
         self.dispatch_log = []
+        # Recovery surface — same contract as the single-chip engine
+        # (stateright_tpu/checkpoint.py): in-loop auto-checkpointing at
+        # superstep boundaries plus resume-provenance gauges.
+        from ..checkpoint import AutoCheckpointer
+
+        self._autockpt = AutoCheckpointer.resolve(
+            checkpoint_to, checkpoint_every, checkpoint_keep
+        )
+        self._last_checkpoint: Optional[Dict[str, Any]] = None
+        self._resumed_from: Optional[str] = checkpoint
 
         if checkpoint is not None:
             # Skip init seeding entirely; _restore builds the whole state.
             self._restore(checkpoint)
+            if self._autockpt is not None:
+                self._autockpt.arm(self._depth)
             return
 
         # --- initial device state ----------------------------------------
@@ -245,13 +260,28 @@ class ShardedXlaChecker(Checker):
         self._unique_count = int(n_unique_init)
         self._frontier_total_cache = n_init
         self._exhausted = n_init == 0
+        if self._autockpt is not None:
+            self._autockpt.arm(self._depth)
 
     # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
 
-    def save_checkpoint(self, path: str) -> None:
-        from ..checkpoint import save_checkpoint
+    def save_checkpoint(self, path: str, keep: int = 1) -> None:
+        """The single-chip implementation (atomic + rotating save, obs
+        span, ``checkpoints_written`` counter, ``last_checkpoint`` gauge),
+        gated to process 0: under ``jax.distributed`` every rank reaches
+        the same quiescent point with the same allgathered payload
+        (``_host_read``), so rank 0's write IS the complete checkpoint —
+        and concurrent writers on one base path would sweep each other's
+        temp files and double-shift the rotation chain."""
+        import jax
 
-        save_checkpoint(self, path)
+        if jax.process_index() != 0:
+            return
+        XlaChecker.save_checkpoint(self, path, keep)
+
+    # The in-loop auto-checkpoint hook routes through save_checkpoint
+    # above, so the process-0 gate covers automatic writes too.
+    _maybe_checkpoint = XlaChecker._maybe_checkpoint
 
     def _restore(self, path: str) -> None:
         """Loads a checkpoint, re-routing frontier rows and table entries to
@@ -1482,6 +1512,9 @@ class ShardedXlaChecker(Checker):
             self._pin_found_names()
             if self._hv_idx:
                 self._confirm_hv_candidates(hv_w, hv_f, hv_c)
+            # Quiescent point: the committed prefix is fully reflected in
+            # host-visible state.
+            self._maybe_checkpoint()
             if (
                 self._target_state_count is not None
                 and self._state_count >= self._target_state_count
@@ -1587,6 +1620,7 @@ class ShardedXlaChecker(Checker):
         self._pin_found_names()
         if self._hv_idx:
             self._confirm_hv_candidates(hv_w, hv_f, hv_c)
+        self._maybe_checkpoint()
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count
@@ -1659,6 +1693,12 @@ class ShardedXlaChecker(Checker):
             "cand_ladder_k": 1,
             "shrink_exit": False,
             "levels_per_dispatch": self._levels_per_dispatch,
+            "checkpoint_to": self._autockpt.path if self._autockpt else None,
+            # -- recovery gauges (docs/observability.md "Recovery") ----
+            "resumed_from": self._resumed_from,
+            "last_checkpoint_level": (
+                self._last_checkpoint["depth"] if self._last_checkpoint else None
+            ),
             "shards": self._D,
             "frontier_rows_per_shard": self._Fl,
             "table_slots_per_shard": self._Cl,

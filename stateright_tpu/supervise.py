@@ -1,0 +1,330 @@
+"""Crash-recovery supervisor: run wedge-prone device work to completion.
+
+The axon TPU tunnel WEDGES — blocks forever rather than failing — so every
+long device run needs an outside supervisor. Until this module, that
+supervisor existed twice as near-copies (bench.py's heartbeat-aware
+watchdog, tools/tpu_watch.sh's ``hb_stale``) and recovery meant
+*restarting from level 0*. This is the ONE library form of both halves:
+
+- :func:`heartbeat_verdict` — the protocol table from
+  docs/observability.md, as a function: given the worker's heartbeat file
+  (``stateright_tpu/obs/heartbeat.py``), decide *alive* (None) or a kill
+  reason. Stale in ``phase="idle"`` is host-side work — never a kill; a
+  stale ``phase="dispatch"`` beat is a wedged tunnel, with a stretched
+  leash when the beat flags an in-flight XLA compile.
+- :func:`run_worker` — ONE supervised attempt: spawn the worker in its own
+  process group (``start_new_session``), poll the heartbeat, kill the
+  whole group on a wedge verdict or the hard timeout (SIGTERM, then
+  SIGKILL — which also takes SIGSTOP-frozen processes). The heartbeat file
+  is unlinked on the way out: a dead worker's final ``phase="dispatch"``
+  beat must not read as a wedge to an outer watcher.
+- :func:`supervise` — the retry loop: bounded attempts with exponential
+  backoff, each retry RESUMING from the latest *valid* rotation of the
+  worker's checkpoint (``stateright_tpu/checkpoint.py``) — a torn newest
+  rotation is skipped automatically in favor of the previous one — plus an
+  optional final fallback attempt (e.g. a CPU worker, supervised by the
+  hard timeout alone: no tunnel, no wedge).
+
+The worker contract: it writes checkpoints (normally via
+``spawn_xla(checkpoint_to=...)``), beats ``STPU_HEARTBEAT`` (injected into
+its environment here), and accepts a resume path from ``make_argv`` —
+how the path rides into the worker (CLI flag, env var) is the caller's
+choice. ``bench.py`` and ``tools/soak.py`` are the two in-tree users.
+
+Everything here is stdlib + the obs/checkpoint helpers — importing this
+module never imports jax, so a supervisor process stays wedge-proof
+itself.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Union
+
+from . import checkpoint as ck_mod
+from .obs import heartbeat as hb_mod
+
+
+def heartbeat_verdict(
+    path: str,
+    *,
+    started_wall: float,
+    elapsed_s: float,
+    stall_s: float,
+    startup_grace_s: float,
+    compile_leash: float = 3.0,
+) -> Optional[str]:
+    """The watchdog's per-poll decision: None = leave the worker alone,
+    else the kill reason. Implements the heartbeat-protocol table
+    (docs/observability.md): beats older than ``started_wall`` are a
+    previous run's; a worker that never beat gets ``startup_grace_s``
+    (imports + init inserts can wedge before the first dispatch); stale in
+    ``phase="idle"`` is host-side work (the hard timeout governs); stale
+    mid-``phase="dispatch"`` past the leash (x ``compile_leash`` when the
+    beat flags a fresh XLA compile) is a wedged tunnel."""
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        mtime = None
+    if mtime is None or mtime < started_wall:
+        if elapsed_s > startup_grace_s:
+            return f"no heartbeat within {startup_grace_s:.0f}s startup grace"
+        return None
+    rec = hb_mod.read(path) or {}
+    if rec.get("phase") != "dispatch":
+        return None
+    age = time.time() - mtime
+    allow = stall_s * (compile_leash if rec.get("compile") else 1)
+    if age > allow:
+        return (
+            f"heartbeat stale {age:.0f}s > {allow:.0f}s mid-dispatch "
+            f"(compile={bool(rec.get('compile'))}, seq={rec.get('seq', '?')})"
+            " — wedged worker"
+        )
+    return None
+
+
+@dataclass
+class WorkerResult:
+    """One supervised attempt's outcome."""
+
+    rc: Optional[int]  #: exit code; None when the watchdog killed it
+    killed: Optional[str]  #: kill reason, or None for a natural exit
+    seconds: float
+    stdout_path: Optional[str]
+
+    @property
+    def ok(self) -> bool:
+        return self.killed is None and self.rc == 0
+
+
+def _kill_group(proc: subprocess.Popen, grace_s: float = 2.0) -> None:
+    """Kill the worker's whole process group: TERM first (a healthy-but-slow
+    tree gets to flush), then KILL — which also takes SIGSTOP-frozen
+    processes, where TERM would sit pending forever."""
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        try:
+            os.killpg(proc.pid, sig)
+        except ProcessLookupError:
+            break
+        except OSError:
+            proc.kill()
+        try:
+            proc.wait(timeout=grace_s)
+            break
+        except subprocess.TimeoutExpired:
+            continue
+    try:
+        proc.wait(timeout=grace_s)
+    except subprocess.TimeoutExpired:  # pragma: no cover - unkillable child
+        pass
+
+
+def run_worker(
+    argv: Sequence[str],
+    *,
+    heartbeat: Optional[str] = None,
+    timeout_s: float = float("inf"),
+    stall_s: float = 1200.0,
+    startup_grace_s: float = 900.0,
+    compile_leash: float = 3.0,
+    env: Optional[dict] = None,
+    cwd: Optional[str] = None,
+    stdout_path: Optional[str] = None,
+    poll_s: float = 5.0,
+    log: Optional[Callable[[str], None]] = None,
+) -> WorkerResult:
+    """ONE supervised attempt of ``argv``.
+
+    The worker runs in its own process group; with ``heartbeat`` set the
+    path is exported as ``STPU_HEARTBEAT`` (the engines beat it around
+    every device dispatch) and polled every ``poll_s`` under
+    :func:`heartbeat_verdict`; without it only the hard ``timeout_s``
+    supervises (the CPU-fallback mode: no tunnel, no wedge). Worker stdout
+    goes to ``stdout_path`` (a file, not a pipe — the parent never reads
+    concurrently, so a pipe could deadlock a chatty worker, and a file
+    survives for post-mortem salvage no matter how the worker dies)."""
+    _log = log or (lambda msg: None)
+    env = dict(os.environ if env is None else env)
+    if heartbeat is not None:
+        heartbeat = os.path.abspath(heartbeat)
+        os.makedirs(os.path.dirname(heartbeat) or ".", exist_ok=True)
+        env["STPU_HEARTBEAT"] = heartbeat
+    # heartbeat=None leaves an inherited STPU_HEARTBEAT untouched: a
+    # worker whose INNER watchdog is off may still beat an OUTER
+    # watcher's stage file (tpu_watch.sh + BENCH_HEARTBEAT=0). Callers
+    # that must silence beats entirely scrub their env themselves — the
+    # CPU paths in bench.py/soak.py and supervise()'s fallback below.
+    out_fh = open(stdout_path, "w") if stdout_path else None
+    t0 = time.monotonic()
+    wall0 = time.time()
+    killed = None
+    try:
+        proc = subprocess.Popen(
+            list(argv),
+            stdout=out_fh,
+            env=env,
+            cwd=cwd,
+            start_new_session=True,
+        )
+        while True:
+            try:
+                proc.wait(timeout=poll_s)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            elapsed = time.monotonic() - t0
+            if elapsed > timeout_s:
+                killed = f"hard timeout {timeout_s:.0f}s"
+                break
+            if heartbeat is not None:
+                killed = heartbeat_verdict(
+                    heartbeat,
+                    started_wall=wall0,
+                    elapsed_s=elapsed,
+                    stall_s=stall_s,
+                    startup_grace_s=startup_grace_s,
+                    compile_leash=compile_leash,
+                )
+                if killed is not None:
+                    break
+        if killed is not None:
+            _log(f"killing worker group (pid {proc.pid}): {killed}")
+            _kill_group(proc)
+    finally:
+        if out_fh is not None:
+            out_fh.close()
+        if heartbeat is not None:
+            # Live supervision state, not an artifact: a dead worker's
+            # final phase="dispatch" beat must not linger for an outer
+            # watcher to read as a wedge.
+            try:
+                os.unlink(heartbeat)
+            except OSError:
+                pass
+    return WorkerResult(
+        rc=None if killed else proc.returncode,
+        killed=killed,
+        seconds=time.monotonic() - t0,
+        stdout_path=stdout_path,
+    )
+
+
+#: ``make_argv(attempt, resume)`` — the worker command line for this
+#: attempt. ``resume`` is the checkpoint path to resume from (the latest
+#: valid rotation), or None for a cold start.
+MakeArgv = Callable[[int, Optional[str]], Sequence[str]]
+
+
+@dataclass
+class SuperviseResult:
+    ok: bool
+    attempts: List[WorkerResult] = field(default_factory=list)
+    #: The resume path each attempt was handed (None = cold start), index-
+    #: aligned with ``attempts``; a fallback attempt appends here too.
+    resumed_from: List[Optional[str]] = field(default_factory=list)
+    used_fallback: bool = False
+
+    @property
+    def final(self) -> Optional[WorkerResult]:
+        return self.attempts[-1] if self.attempts else None
+
+
+def supervise(
+    make_argv: MakeArgv,
+    *,
+    checkpoint: Optional[str] = None,
+    retries: int = 2,
+    backoff_s: float = 5.0,
+    success: Optional[Callable[[WorkerResult], bool]] = None,
+    fallback_make_argv: Optional[MakeArgv] = None,
+    fallback_timeout_s: Optional[float] = None,
+    log: Optional[Callable[[str], None]] = None,
+    stdout_path: Union[None, str, Callable[[int], str]] = None,
+    **worker_kw,
+) -> SuperviseResult:
+    """Run a worker to success with bounded retries, resuming each retry
+    from the latest valid rotation of ``checkpoint``.
+
+    ``1 + retries`` attempts of ``make_argv(attempt, resume)``; before each
+    attempt the resume path is re-resolved via
+    :func:`checkpoint.latest_valid_checkpoint`, so progress a previous
+    attempt checkpointed is never re-explored and a torn newest rotation
+    falls back to the one before it automatically. Retries back off
+    exponentially from ``backoff_s``. ``success`` (default: exit code 0)
+    judges each attempt. If every attempt fails and ``fallback_make_argv``
+    is given, ONE final attempt runs it — heartbeat supervision off, hard
+    ``fallback_timeout_s`` only (the CPU-fallback mode) — still handed the
+    latest resume path. Remaining keyword arguments go to
+    :func:`run_worker`."""
+    _log = log or (lambda msg: None)
+    judge = success or (lambda r: r.ok)
+    result = SuperviseResult(ok=False)
+
+    def attempt_once(attempt: int, builder: MakeArgv, **kw) -> bool:
+        resume = (
+            ck_mod.latest_valid_checkpoint(checkpoint) if checkpoint else None
+        )
+        sp = stdout_path(attempt) if callable(stdout_path) else stdout_path
+        res = run_worker(
+            builder(attempt, resume), stdout_path=sp, log=_log, **kw
+        )
+        result.attempts.append(res)
+        result.resumed_from.append(resume)
+        if judge(res):
+            result.ok = True
+            return True
+        _log(
+            f"attempt {attempt} failed (rc={res.rc}, killed={res.killed}, "
+            f"{res.seconds:.0f}s)"
+        )
+        return False
+
+    for attempt in range(1 + retries):
+        if attempt and backoff_s:
+            delay = backoff_s * (2 ** (attempt - 1))
+            _log(f"retry {attempt}/{retries} after {delay:.0f}s backoff")
+            time.sleep(delay)
+        if attempt_once(attempt, make_argv, **worker_kw):
+            return result
+    if fallback_make_argv is not None:
+        _log("retries exhausted; falling back (heartbeat supervision off)")
+        kw = dict(worker_kw)
+        kw.pop("heartbeat", None)
+        kw.pop("stall_s", None)
+        kw.pop("startup_grace_s", None)
+        kw.pop("compile_leash", None)
+        # The fallback worker (typically CPU: no tunnel, no wedge) must
+        # not beat an OUTER watcher's stage file either — on this 1-core
+        # box a long CPU dispatch legitimately outlives any stall leash,
+        # so an inherited STPU_HEARTBEAT would get the healthy fallback
+        # killed as a "wedge".
+        fenv = dict(kw.pop("env", None) or os.environ)
+        fenv.pop("STPU_HEARTBEAT", None)
+        kw["env"] = fenv
+        if fallback_timeout_s is not None:
+            kw["timeout_s"] = fallback_timeout_s
+        result.used_fallback = True
+        attempt_once(len(result.attempts), fallback_make_argv, **kw)
+    return result
+
+
+if __name__ == "__main__":  # pragma: no cover - tiny manual harness
+    # python -m stateright_tpu.supervise -- CMD ...   (one watched attempt)
+    args = sys.argv[1:]
+    if args and args[0] == "--":
+        args = args[1:]
+    res = run_worker(
+        args,
+        heartbeat=os.environ.get("STPU_HEARTBEAT"),
+        timeout_s=float(os.environ.get("SUPERVISE_TIMEOUT_S", "inf")),
+        stall_s=float(os.environ.get("SUPERVISE_STALL_S", "1200")),
+        log=lambda m: print(f"[supervise] {m}", file=sys.stderr, flush=True),
+    )
+    print(f"[supervise] rc={res.rc} killed={res.killed}", file=sys.stderr)
+    sys.exit(res.rc if res.rc is not None else 125)

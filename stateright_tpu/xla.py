@@ -83,6 +83,7 @@ ENGINE_COUNTERS = (
     "delta_flushes",
     "shrink_exits",
     "ladder_jumps",
+    "checkpoints_written",
 )
 
 
@@ -151,6 +152,9 @@ class XlaChecker(Checker):
         visit_cap: int = 4096,
         levels_per_dispatch: int = 32,
         checkpoint: Optional[str] = None,
+        checkpoint_to: Optional[str] = None,
+        checkpoint_every: Any = None,
+        checkpoint_keep: Optional[int] = None,
         dedup: str = "auto",
         compaction: str = "auto",
         ladder: str = "auto",
@@ -172,6 +176,16 @@ class XlaChecker(Checker):
         self._tracer = obs.resolve_tracer(trace)
         self._heartbeat = obs.resolve_heartbeat(heartbeat)
         self._counters = obs.Counters(ENGINE_COUNTERS)
+        # Recovery surface (stateright_tpu/checkpoint.py): in-loop
+        # auto-checkpointing at superstep boundaries (the quiescent
+        # points), plus the resume-provenance gauges metrics() reports.
+        from .checkpoint import AutoCheckpointer
+
+        self._autockpt = AutoCheckpointer.resolve(
+            checkpoint_to, checkpoint_every, checkpoint_keep
+        )
+        self._last_checkpoint: Optional[Dict[str, Any]] = None
+        self._resumed_from: Optional[str] = checkpoint
         self._symmetry = builder._symmetry is not None
         if self._symmetry and not hasattr(model, "packed_representative"):
             raise TypeError(
@@ -527,6 +541,8 @@ class XlaChecker(Checker):
             self._frontier_capacity = max(frontier_capacity, 16)
             self._table = self._ds.make(table_capacity, jnp)
             self._restore(checkpoint)
+            if self._autockpt is not None:
+                self._autockpt.arm(self._depth)
             return
 
         init_packed = np.asarray(model.packed_init(), dtype=np.uint32)
@@ -566,13 +582,39 @@ class XlaChecker(Checker):
         self._state_count = n_init
         self._unique_count = n_unique_init
         self._exhausted = n_init == 0
+        if self._autockpt is not None:
+            self._autockpt.arm(self._depth)
 
     # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
 
-    def save_checkpoint(self, path: str) -> None:
-        from .checkpoint import save_checkpoint
+    def save_checkpoint(self, path: str, keep: int = 1) -> None:
+        """Atomic (+ rotating, with ``keep > 1``) checkpoint of the current
+        search state; also the sink of the in-loop auto-checkpointer, so
+        the obs span, the ``checkpoints_written`` counter, and the
+        ``last_checkpoint`` gauge live here for manual and automatic saves
+        alike."""
+        from .checkpoint import _normalize, save_checkpoint
 
-        save_checkpoint(self, path)
+        with self._tracer.span(
+            "checkpoint", path=path, depth=self._depth, keep=keep
+        ):
+            save_checkpoint(self, path, keep=keep)
+        self._counters.inc("checkpoints_written")
+        self._last_checkpoint = {
+            "path": _normalize(path),
+            "depth": self._depth,
+            "states": self._state_count,
+            "unique": self._unique_count,
+            "unix_ts": time.time(),
+        }
+
+    def _maybe_checkpoint(self) -> None:
+        """In-loop auto-checkpoint hook, called at every quiescent point
+        (between supersteps, after commit bookkeeping) by both dispatch
+        paths. No-op unless ``spawn_xla(checkpoint_to=...)`` /
+        ``STPU_CHECKPOINT_TO`` armed a cadence."""
+        if self._autockpt is not None:
+            self._autockpt.maybe(self)
 
     def _restore(self, path: str) -> None:
         """Replaces the freshly-initialized search state with a checkpoint's
@@ -2219,6 +2261,10 @@ class XlaChecker(Checker):
             if self._hv_idx:
                 self._confirm_hv_candidates(hv_w, hv_f, hv_c)
             self._pin_found_names()
+            # Quiescent point: the committed prefix is fully reflected in
+            # host-visible state (even when this iteration ended on an
+            # overflow — the overflowing level was not committed).
+            self._maybe_checkpoint()
             if (
                 self._target_state_count is not None
                 and self._state_count >= self._target_state_count
@@ -2380,6 +2426,7 @@ class XlaChecker(Checker):
         if self._hv_idx:
             self._confirm_hv_candidates(hv_words, hv_fps, hv_counts)
         self._pin_found_names()
+        self._maybe_checkpoint()
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count
@@ -2503,6 +2550,12 @@ class XlaChecker(Checker):
             "cand_ladder_k": self._cand_ladder_k,
             "shrink_exit": self._shrink_exit,
             "levels_per_dispatch": self._levels_per_dispatch,
+            "checkpoint_to": self._autockpt.path if self._autockpt else None,
+            # -- recovery gauges (docs/observability.md "Recovery") ----
+            "resumed_from": self._resumed_from,
+            "last_checkpoint_level": (
+                self._last_checkpoint["depth"] if self._last_checkpoint else None
+            ),
             # -- live search gauges -----------------------------------
             "state_count": self._state_count,
             "unique_state_count": self._unique_count,

@@ -1,21 +1,33 @@
 """Checkpoint/resume tests: stop after any super-step, resume later, on a
 different engine/mesh — counts and discoveries must come out identical to an
-uninterrupted run."""
+uninterrupted run. Plus the crash-safety layer (ISSUE 8): atomic writes,
+keep-K rotation, the embedded payload digest, the typed
+``CheckpointCorrupt`` on torn files with automatic rotation fallback, and
+the in-loop auto-checkpointer on both engines."""
+
+import json
+import os
 
 import numpy as np
 import pytest
 
 import jax
 
+from stateright_tpu import checkpoint as ck_mod
 from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
 from stateright_tpu.parallel import default_mesh
 
 
+_REF = None
+
+
 def _full_run_reference():
-    checker = PackedTwoPhaseSys(4).checker().spawn_xla(
-        frontier_capacity=1 << 10, table_capacity=1 << 13
-    ).join()
-    return checker
+    global _REF
+    if _REF is None:
+        _REF = PackedTwoPhaseSys(4).checker().spawn_xla(
+            frontier_capacity=1 << 10, table_capacity=1 << 13
+        ).join()
+    return _REF
 
 
 def test_single_chip_save_resume_roundtrip(tmp_path):
@@ -73,8 +85,17 @@ def test_cross_engine_single_chip_to_sharded(tmp_path):
         checkpoint=path,
     )
     assert resumed.unique_state_count() == partial.unique_state_count()
+    assert resumed.state_count() == partial.state_count()
     resumed.join()
+    # The full-coverage pins (bench.py EXPECTED_2PC[4]): a cross-engine
+    # resume reports the exact generated AND unique totals of an
+    # uninterrupted run, and finds the same properties.
     assert resumed.unique_state_count() == 1_568
+    assert resumed.state_count() == 8_258
+    assert resumed.metrics()["resumed_from"] == path
+    ref = _full_run_reference()
+    assert resumed.max_depth() == ref.max_depth()
+    assert set(resumed.discoveries()) == set(ref.discoveries())
     resumed.assert_properties()
 
 
@@ -92,6 +113,10 @@ def test_cross_engine_sharded_to_single_chip(tmp_path):
         frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=path
     ).join()
     assert resumed.unique_state_count() == 1_568
+    assert resumed.state_count() == 8_258
+    ref = _full_run_reference()
+    assert resumed.max_depth() == ref.max_depth()
+    assert set(resumed.discoveries()) == set(ref.discoveries())
     resumed.assert_properties()
 
 
@@ -104,6 +129,173 @@ def test_checkpoint_rejects_wrong_model(tmp_path):
         PackedTwoPhaseSys(5).checker().spawn_xla(
             frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=path
         )
+
+
+# --- crash-safety: atomic writes, rotation, digest, typed corruption ------
+
+
+def _partial(n_blocks=4, **kw):
+    c = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13, **kw
+    )
+    for _ in range(n_blocks):
+        c._run_block()
+    return c
+
+
+def test_save_is_atomic_no_temp_left(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    c = _partial(levels_per_dispatch=1)
+    c.save_checkpoint(path)
+    # The write went live via os.replace; no temp file survives success.
+    assert sorted(os.listdir(tmp_path)) == ["ck.npz"]
+    ck_mod.load_checkpoint(path)  # and the live file verifies clean
+
+
+def test_rotation_keeps_last_k(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    c = _partial(n_blocks=2, levels_per_dispatch=1)
+    depths = []
+    for _ in range(4):  # 4 saves at keep=3: the oldest falls off
+        c.save_checkpoint(path, keep=3)
+        depths.append(c._depth)
+        c._run_block()
+    rots = ck_mod.rotations(path)
+    assert rots == [path, f"{path}.1", f"{path}.2"]
+    # Newest first: the live file has the last save's depth, .1 the one
+    # before, .2 the one before that; the first save was discarded.
+    got = [ck_mod.load_checkpoint(p)["meta"]["depth"] for p in rots]
+    assert got == depths[:0:-1]
+
+
+def test_truncated_checkpoint_raises_typed(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    _partial().save_checkpoint(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 3)
+    with pytest.raises(ck_mod.CheckpointCorrupt):
+        ck_mod.load_checkpoint(path)
+    # Not valid, and with no older rotation there is nothing to fall
+    # back to.
+    assert ck_mod.latest_valid_checkpoint(path) is None
+
+
+def test_payload_digest_detects_tampering(tmp_path):
+    # A well-formed archive whose payload no longer matches the embedded
+    # digest (bit rot / a foreign writer): the self-verification catches
+    # what zipfile-level checks cannot.
+    path = str(tmp_path / "ck.npz")
+    _partial().save_checkpoint(path)
+    with np.load(path) as z:
+        members = {k: np.asarray(z[k]) for k in z.files}
+    assert members["key_lo"].size > 0
+    members["key_lo"] = members["key_lo"] ^ np.uint32(1)
+    np.savez_compressed(path, **members)  # meta (and its digest) unchanged
+    with pytest.raises(ck_mod.CheckpointCorrupt, match="digest mismatch"):
+        ck_mod.load_checkpoint(path)
+
+
+def test_latest_valid_falls_back_past_torn_rotation(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    c = _partial(levels_per_dispatch=1)
+    c.save_checkpoint(path, keep=2)
+    c._run_block()
+    c.save_checkpoint(path, keep=2)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    assert ck_mod.latest_valid_checkpoint(path) == f"{path}.1"
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        checkpoint=f"{path}.1",
+    ).join()
+    assert resumed.state_count() == 8_258
+    assert resumed.unique_state_count() == 1_568
+
+
+# --- in-loop auto-checkpointing -------------------------------------------
+
+
+def test_autockpt_level_cadence_and_resume(tmp_path):
+    path = str(tmp_path / "auto.npz")
+    c = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        levels_per_dispatch=1,
+        checkpoint_to=path, checkpoint_every=2, checkpoint_keep=3,
+    ).join()
+    m = c.metrics()
+    assert m["checkpoint_to"] == path
+    assert m["checkpoints_written"] >= 3
+    assert m["last_checkpoint_level"] is not None
+    assert m["resumed_from"] is None
+    assert len(ck_mod.rotations(path)) == 3  # keep bound respected
+    # The engine-visible gauge matches the newest rotation's metadata.
+    latest = ck_mod.latest_valid_checkpoint(path)
+    meta = ck_mod.load_checkpoint(latest)["meta"]
+    assert meta["depth"] == m["last_checkpoint_level"]
+    # Resuming the newest auto-checkpoint converges to the exact totals.
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=latest
+    ).join()
+    assert resumed.state_count() == c.state_count() == 8_258
+    assert resumed.unique_state_count() == 1_568
+    assert resumed.metrics()["resumed_from"] == latest
+
+
+def test_autockpt_seconds_cadence(tmp_path):
+    path = str(tmp_path / "auto_s.npz")
+    c = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        levels_per_dispatch=1,
+        checkpoint_to=path, checkpoint_every="0.001s",
+    ).join()
+    # Sub-millisecond cadence => a write at (nearly) every dispatch
+    # boundary; at minimum the cadence fired repeatedly.
+    assert c.metrics()["checkpoints_written"] >= 3
+
+
+def test_autockpt_env_knobs(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.npz")
+    monkeypatch.setenv("STPU_CHECKPOINT_TO", path)
+    monkeypatch.setenv("STPU_CHECKPOINT_EVERY", "1")
+    monkeypatch.setenv("STPU_CHECKPOINT_KEEP", "2")
+    c = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        levels_per_dispatch=1,
+    ).join()
+    assert c.metrics()["checkpoints_written"] >= 2
+    assert len(ck_mod.rotations(path)) == 2
+    ck_mod.load_checkpoint(path)
+
+
+def test_autockpt_bad_cadence_rejected(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        PackedTwoPhaseSys(4).checker().spawn_xla(
+            frontier_capacity=1 << 10, table_capacity=1 << 13,
+            checkpoint_to=str(tmp_path / "x.npz"), checkpoint_every="soon",
+        )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device mesh")
+def test_autockpt_sharded_then_single_chip_resume(tmp_path):
+    path = str(tmp_path / "mesh_auto.npz")
+    c = PackedTwoPhaseSys(4).checker().spawn_xla(
+        mesh=default_mesh(8),
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        levels_per_dispatch=1,
+        checkpoint_to=path, checkpoint_every=1,
+    ).join()
+    m = c.metrics()
+    assert m["checkpoints_written"] >= 3
+    assert m["last_checkpoint_level"] is not None
+    latest = ck_mod.latest_valid_checkpoint(path)
+    assert latest is not None
+    # A mesh-written auto-checkpoint resumes on the single-chip engine —
+    # the cross-engine contract holds for the recovery path too.
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13, checkpoint=latest
+    ).join()
+    assert resumed.state_count() == 8_258
+    assert resumed.unique_state_count() == 1_568
 
 
 def test_checkpoint_preserves_discovery_pins(tmp_path):

@@ -49,6 +49,11 @@ METRIC_KEYS = {
     "levels_committed", "cand_retries", "hv", "table_grows",
     "frontier_grows", "cand_grows", "delta_flushes", "shrink_exits",
     "ladder_jumps",
+    # recovery keys (docs/observability.md "Recovery"): the auto-
+    # checkpoint config gauge, resume provenance, the last checkpointed
+    # level, and the write counter.
+    "checkpoint_to", "resumed_from", "last_checkpoint_level",
+    "checkpoints_written",
 }
 
 
@@ -186,9 +191,15 @@ def test_metrics_keys_across_dedups(dedup):
     assert 0 < m["table_occupancy"] <= 1
     for counter in (
         "table_grows", "frontier_grows", "cand_grows", "delta_flushes",
-        "shrink_exits", "ladder_jumps",
+        "shrink_exits", "ladder_jumps", "checkpoints_written",
     ):
         assert isinstance(m[counter], int) and m[counter] >= 0
+    # No checkpointing configured on this spawn: the recovery gauges read
+    # as the documented "off" values.
+    assert m["checkpoint_to"] is None
+    assert m["resumed_from"] is None
+    assert m["last_checkpoint_level"] is None
+    assert m["checkpoints_written"] == 0
     json.dumps(m)  # the snapshot is JSON-serializable as-is
 
 
@@ -216,9 +227,33 @@ def test_explorer_status_carries_metrics():
         PackedTwoPhaseSys(2).checker(),
         frontier_capacity=1 << 8, table_capacity=1 << 10,
     )
-    m = app.status()["metrics"]
+    status = app.status()
+    m = status["metrics"]
     assert m["engine"] == "xla"
     assert "pending_pool" in m and "waiting" in m  # on-demand gauges
+    # Recovery state is part of the status surface: a wedged interactive
+    # session must be diagnosable (and resumable) from /.status alone.
+    assert "last_checkpoint" in status
+
+
+def test_checkpoint_span_per_write(tmp_path):
+    # Every auto-checkpoint write emits one "checkpoint" span whose attrs
+    # name the file, the depth it captured, and the rotation bound — and
+    # the span count agrees with the checkpoints_written counter.
+    trace = str(tmp_path / "ck_trace.jsonl")
+    ck = str(tmp_path / "ck.npz")
+    c = _spawn(
+        trace=trace, checkpoint_to=ck, checkpoint_every=1,
+        levels_per_dispatch=1,
+    ).join()
+    m = c.metrics()
+    assert m["checkpoints_written"] >= 1
+    assert m["checkpoint_to"] == ck
+    assert m["last_checkpoint_level"] is not None
+    spans = [r for r in _spans(trace) if r["name"] == "checkpoint"]
+    assert len(spans) == m["checkpoints_written"]
+    for rec in spans:
+        assert {"path", "depth", "keep"} <= set(rec["attrs"])
 
 
 # --- dispatch_log contract ------------------------------------------------

@@ -2,8 +2,10 @@
 # Tier-0 smoke: a <5-minute subset to run BEFORE the ~50-minute full
 # suite — the observability schemas (trace/heartbeat/metrics/dispatch_log
 # consumers parse these), one fused-vs-single exactness pin (the engine's
-# semantic contract), and one packed-model end-to-end check. A red here
-# means don't bother starting the full run.
+# semantic contract), one packed-model end-to-end check, and a <30s
+# kill-and-resume crash drill (SIGKILL a supervised worker, resume from
+# its auto-checkpoint, exact pinned counts — the recovery stack's tier-0
+# proof). A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
 set -euo pipefail
@@ -12,4 +14,5 @@ exec timeout -k 10 290 python -m pytest \
   tests/test_obs.py \
   tests/test_fused_dispatch.py::test_fused_matches_single_full_coverage \
   tests/test_packed_increment.py \
+  tests/test_supervise.py::test_smoke_kill_resume \
   -x -q -p no:cacheprovider "$@"

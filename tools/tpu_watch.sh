@@ -25,6 +25,12 @@
 # With no stages, the default plan is a single stage running the staged
 # measurement script:  plan,7200,runs/tpu_plan.log,bash tools/tpu_plan.sh
 #
+# The bare stage name "soak_resume" is a built-in alias for the SUPERVISED
+# rm=10 soak (python tools/soak.py --config rm10 --audit): the worker
+# auto-checkpoints, and after a wedge the soak's own supervisor resumes it
+# from the latest valid checkpoint rotation (docs/observability.md
+# "Recovery").
+#
 # Wedge detection is HEARTBEAT-AWARE (stateright_tpu/obs/heartbeat.py,
 # docs/observability.md): every stage runs with STPU_HEARTBEAT pointed at
 # a per-stage file the engines rewrite around each device dispatch. A
@@ -62,6 +68,17 @@ if [ ${#STAGES[@]} -eq 0 ]; then
   STAGES=("plan,7200,runs/tpu_plan.log,bash tools/tpu_plan.sh")
 fi
 
+# Built-in stage alias: a bare "soak_resume" expands to the SUPERVISED
+# rm=10 soak (tools/soak.py) — the worker auto-checkpoints and the soak's
+# own supervisor resumes it after a wedge, so this outer watcher only
+# backstops a dead supervisor. (soak.py reuses the stage's STPU_HEARTBEAT
+# for its worker, so hb_stale below still sees real engine liveness.)
+for i in "${!STAGES[@]}"; do
+  if [ "${STAGES[$i]}" = "soak_resume" ]; then
+    STAGES[$i]="soak_resume,14400,runs/soak_resume.log,python tools/soak.py --config rm10 --audit"
+  fi
+done
+
 mkdir -p runs "$MARK"
 log() { echo "[tpu_watch $(date +%H:%M:%S)] $*" >>"$LOG"; }
 probe() { timeout 60 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" >>"$LOG" 2>&1; }
@@ -86,27 +103,32 @@ commit_stage() {
 # exists, postdates the stage start, and is stale past its leash WHILE
 # the engine is mid-dispatch. Stale in phase="idle" is host-side work
 # (audits, witness reconstruction), not the tunnel — the hard timeout
-# governs there, per the protocol (docs/observability.md).
+# governs there. The verdict itself is the LIBRARY's
+# (stateright_tpu/supervise.py heartbeat_verdict — the same code bench.py
+# runs), so the protocol table lives in exactly one place; startup grace
+# is infinite here because this watcher's hard timeout governs pre-beat.
 hb_stale() {
   python - "$1" "$2" "$STALL_S" <<'EOF'
-import json, os, sys, time
-path, start, stall = sys.argv[1], float(sys.argv[2]), float(sys.argv[3])
+import sys, traceback
 try:
-    mtime = os.stat(path).st_mtime
-except OSError:
-    sys.exit(1)  # no beat yet: hard timeout governs
-if mtime < start:
-    sys.exit(1)  # a previous run's file
-age = time.time() - mtime
-try:
-    rec = json.load(open(path))
+    sys.path.insert(0, ".")
+    from stateright_tpu.supervise import heartbeat_verdict
+    path, start, stall = sys.argv[1], float(sys.argv[2]), float(sys.argv[3])
+    verdict = heartbeat_verdict(
+        path, started_wall=start, elapsed_s=0.0, stall_s=stall,
+        startup_grace_s=float("inf"),
+    )
 except Exception:
-    rec = {}
-if rec.get("phase") != "dispatch":
-    sys.exit(1)  # host-side work: not a tunnel wedge
-allow = stall * (3 if rec.get("compile") else 1)
-sys.exit(0 if age > allow else 1)
+    # rc 3 = "verdict unavailable", distinct from rc 1 = "not stale":
+    # an import/protocol error must be LOGGED, not silently read as a
+    # healthy worker for the rest of the stage.
+    traceback.print_exc()
+    sys.exit(3)
+sys.exit(0 if verdict else 1)
 EOF
+  local rc=$?
+  [ "$rc" -eq 3 ] && log "hb_stale ERROR (verdict unavailable; only the hard timeout governs this poll)"
+  return "$rc"
 }
 
 # run_stage NAME TIMEOUT OUT CMD... — marker on rc==0; bench.py stages
