@@ -8,6 +8,21 @@ would hang the process when the tunnel is down. ``bench.py`` probes in a
 watchdog subprocess for exactly this reason; this module gives the example
 CLIs the same protection without duplicating it seven times.
 
+Two tiers of protection:
+
+- :func:`ensure_live_backend` — probe-then-proceed. Cheap, but leaves the
+  **TOCTOU residual** (ADVICE r4): a tunnel that wedges between the probe
+  and this process's own first backend use still hangs the process.
+- :func:`guarded_main` — the same supervised-subprocess pattern the
+  service (``stateright_tpu/service``) runs its jobs under, closing that
+  window: when the probe resolves an accelerator, the CLI re-execs
+  *itself* as a heartbeat-supervised worker (``supervise.run_worker``
+  injects ``STPU_HEARTBEAT``; the engines beat it around every dispatch),
+  so a wedge anywhere — plugin init, first compile, any later dispatch —
+  draws a kill verdict instead of hanging a human's shell, and the CLI
+  gracefully re-runs on the CPU backend. The model ``main()``s route
+  their ``check`` commands through this.
+
 Library code does NOT call this: engines run on whatever backend the
 embedding application configured. Only the ``main()`` entry points (a
 human at a shell, expecting an answer, not a hang) pay the probe.
@@ -18,6 +33,42 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+from typing import Optional, Sequence
+
+#: The supervised re-exec marker: set (to the probed platform) in the
+#: worker child's environment so the re-entered CLI proceeds in-process
+#: instead of recursing into another probe + re-exec.
+_CLI_WORKER_ENV = "STPU_CLI_SUPERVISED"
+
+
+def _probe_platform(timeout_s: int) -> Optional[str]:
+    """The default platform per a throwaway probe subprocess (which pays
+    the full plugin initialization), or None when the probe wedged/died —
+    this process's jax stays untouched either way."""
+    probe = (
+        "import jax; ds = jax.devices(); print('PLATFORM', ds[0].platform)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("PLATFORM "):
+            return line.split(" ", 1)[1].strip()
+    return None
+
+
+def _pin_cpu() -> None:
+    # JAX_PLATFORMS env alone cannot override the sitecustomize's
+    # config-level pin; the config update can.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def ensure_live_backend(timeout_s: int = 45) -> str:
@@ -27,45 +78,114 @@ def ensure_live_backend(timeout_s: int = 45) -> str:
     Returns the platform name the process will use ("tpu", "cpu", ...).
     Must be called BEFORE the first jax backend use in this process.
 
-    The probe subprocess pays the full plugin initialization; a healthy
-    accelerator answers in a few seconds, a wedged tunnel burns the
-    timeout once, and either way the CLI never hangs.
-
     **Residual hang window (TOCTOU, ADVICE r4):** on probe success the
     CLI initializes the accelerator plugin *itself* with no watchdog — a
     tunnel that wedges between the probe and that first real backend use
-    still hangs the process. Accepted for the CLIs: the window is
-    seconds wide and a wedge there would have hung the probe moments
-    later anyway on the next level dispatch, which no in-process guard
-    can prevent (only whole-run subprocess watchdogs can — bench.py's
-    pattern; use it for anything unattended).
-    """
-    probe = (
-        "import jax; ds = jax.devices(); print('PLATFORM', ds[0].platform)"
-    )
-    platform = "cpu"
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", probe],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
-        for line in proc.stdout.splitlines():
-            if line.startswith("PLATFORM "):
-                platform = line.split(" ", 1)[1].strip()
-                break
-        else:
-            proc = None
-    except (subprocess.TimeoutExpired, OSError):
-        proc = None
-    if proc is None or platform == "cpu":
+    still hangs the process. :data:`RESIDUAL_HANG_WINDOW` names it;
+    :func:`guarded_main` (the model CLIs' ``check`` path) closes it by
+    running the whole CLI as a heartbeat-supervised worker."""
+    platform = _probe_platform(timeout_s)
+    if platform is None or platform == "cpu":
         print(
             "accelerator unreachable (or CPU-only build); running on CPU",
             file=sys.stderr,
         )
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        _pin_cpu()
         return "cpu"
     return platform
+
+
+#: The TOCTOU residual of :func:`ensure_live_backend`, spelled out for
+#: callers that accept probe-then-proceed: "probe success to this
+#: process's first backend use" is unwatched — use :func:`guarded_main`
+#: (or any whole-run subprocess watchdog: bench.py, the service) for
+#: anything that must never hang.
+RESIDUAL_HANG_WINDOW = (
+    "between ensure_live_backend()'s probe and this process's own first "
+    "backend use, a tunnel wedge hangs the process"
+)
+
+
+def guarded_main(
+    module: str,
+    cli_args: Optional[Sequence[str]] = None,
+    timeout_s: int = 45,
+    *,
+    stall_s: float = 300.0,
+    startup_grace_s: float = 900.0,
+) -> str:
+    """Wedge-proof CLI bring-up: the supervised-subprocess pattern the
+    service uses, for ``main()`` entry points.
+
+    ``module`` is the CLI's own module path (re-exec runs ``python -m
+    module`` — the CLIs use relative imports, so file-path re-exec would
+    not import); ``cli_args`` the original CLI arguments (default
+    ``sys.argv[1:]``). Returns the platform this process should proceed
+    on — the caller just continues its check. Three paths:
+
+    - Probe resolves CPU (or the probe itself wedges): pin CPU, return
+      ``"cpu"`` — identical to :func:`ensure_live_backend`.
+    - Probe resolves an accelerator: re-exec this CLI as a
+      heartbeat-supervised worker — the child sees :data:`_CLI_WORKER_ENV`
+      and proceeds in-process on the accelerator, beating the injected
+      ``STPU_HEARTBEAT`` around every dispatch. On a clean child exit the
+      parent exits with its code (``SystemExit``). On a wedge verdict —
+      bring-up OR any later dispatch, the window :func:`ensure_live_backend`
+      cannot cover — the child's process group is killed and the parent
+      falls back: pins CPU and returns ``"cpu"``, so the CLI re-runs the
+      check on the host backend instead of hanging.
+    - Already the supervised child: return the probed platform from the
+      env marker and proceed.
+
+    ``stall_s`` is the mid-dispatch heartbeat leash (CLI-sized: minutes,
+    not bench.py's 20 — interactive shapes dispatch far more often than a
+    32-level fused soak block); compile-carrying beats get the standard
+    3x."""
+    inherited = os.environ.get(_CLI_WORKER_ENV)
+    if inherited:
+        return inherited
+    platform = _probe_platform(timeout_s)
+    if platform is None or platform == "cpu":
+        print(
+            "accelerator unreachable (or CPU-only build); running on CPU",
+            file=sys.stderr,
+        )
+        _pin_cpu()
+        return "cpu"
+
+    from . import supervise as sup
+
+    env = dict(os.environ, **{_CLI_WORKER_ENV: platform})
+    hb = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"stpu_cli_hb_{os.getpid()}.json"
+    )
+    args = list(sys.argv[1:] if cli_args is None else cli_args)
+    holder = {}
+    try:
+        res = sup.run_worker(
+            [sys.executable, "-m", module] + args,
+            heartbeat=hb,
+            stall_s=stall_s,
+            startup_grace_s=startup_grace_s,
+            env=env,
+            poll_s=2.0,
+            on_spawn=lambda p: holder.update(proc=p),
+            # stdout_path=None: the child inherits this terminal — the
+            # supervised run IS the CLI's output.
+        )
+    except KeyboardInterrupt:
+        # The child runs in its own session, so terminal SIGINT reaches
+        # only this parent — take the worker's whole group down with us
+        # or an orphan keeps the accelerator (and the terminal).
+        if holder.get("proc") is not None:
+            sup._kill_group(holder["proc"])
+        raise SystemExit(130) from None
+    if res.killed is None and res.rc is not None and res.rc >= 0:
+        raise SystemExit(res.rc)
+    reason = res.killed or f"worker died by signal (rc={res.rc})"
+    print(
+        f"accelerator run aborted ({reason}); re-running on CPU",
+        file=sys.stderr,
+    )
+    _pin_cpu()
+    return "cpu"

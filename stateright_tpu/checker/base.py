@@ -56,12 +56,25 @@ class Checker:
         engine has; the device engines override with the full registry
         (dispatch/growth/flush counters, occupancy and capacity gauges).
         Safe to poll mid-run — the Explorer's ``/.status`` does."""
-        return {
+        out = {
             "engine": type(self).__name__,
             "state_count": self.state_count(),
             "unique_state_count": self.unique_state_count(),
             "max_depth": self.max_depth(),
         }
+        if self._service_job_id is not None:
+            out["job_id"] = self._service_job_id
+        return out
+
+    # --- service hooks (stateright_tpu/service) ---------------------------
+
+    #: Set when this checker serves a ``CheckerService`` job (the Explorer
+    #: registers its interactive checker); threads the job identity through
+    #: ``metrics()`` so pool-wide and per-checker telemetry join up.
+    _service_job_id: Optional[str] = None
+
+    def attach_job(self, job_id: str) -> None:
+        self._service_job_id = job_id
 
     _started = False
 

@@ -186,7 +186,16 @@ class DeviceOnDemandChecker(XlaChecker):
     def metrics(self):
         """The engine registry plus the on-demand surface's own gauges:
         the pending pool (discovered-but-unexpanded states) and whether
-        the checker is still waiting (compute-nothing-until-asked)."""
+        the checker is still waiting (compute-nothing-until-asked).
+
+        As the Explorer's backend this checker is one CLIENT of the
+        multi-tenant ``stateright_tpu/service`` pool: ``make_app``
+        registers it via ``CheckerService.register_interactive`` (typed
+        admission past ``max_sessions``), ``attach_job`` (base Checker)
+        threads the pool job id in here as ``job_id``, and the pool's
+        breaker decides whether a session gets this engine at all — open
+        means the Explorer serves degraded on the host on-demand engine
+        instead."""
         out = super().metrics()
         out["pending_pool"] = len(self._pool)
         out["waiting"] = self._waiting

@@ -76,12 +76,20 @@ class Snapshot:
 
 
 class ExplorerApp:
-    """The Explorer's request handlers, independent of any HTTP machinery."""
+    """The Explorer's request handlers, independent of any HTTP machinery.
 
-    def __init__(self, checker, snapshot: Optional[Snapshot] = None):
+    The Explorer is ONE CLIENT of the :class:`~stateright_tpu.service`
+    pool: ``make_app``/``serve`` register the interactive checker as a
+    service job, so it is admission-controlled and counted alongside batch
+    tenants, and ``/.status`` carries the pool gauges under ``"pool"``."""
+
+    def __init__(self, checker, snapshot: Optional[Snapshot] = None,
+                 service=None, job=None):
         self._checker = checker
         self._snapshot = snapshot or Snapshot()
         self._lock = threading.Lock()
+        self._service = service
+        self._job = job
 
     # --- handlers ---------------------------------------------------------
 
@@ -90,7 +98,7 @@ class ExplorerApp:
         with self._lock:
             checker = self._checker
             recent = self._snapshot.actions
-            return {
+            out = {
                 "done": checker.is_done(),
                 "model": type(checker.model()).__name__,
                 "state_count": checker.state_count(),
@@ -109,6 +117,17 @@ class ExplorerApp:
                 # (and resumable) from the outside.
                 "last_checkpoint": getattr(checker, "_last_checkpoint", None),
             }
+            # Service client fields (additive — the pre-service keys above
+            # are unchanged for existing consumers): this session's pool
+            # job id, whether it is served degraded (host fallback while
+            # the device breaker is open), and the pool-wide gauges.
+            if self._service is not None:
+                out["job"] = self._job.id if self._job is not None else None
+                out["degraded"] = (
+                    self._job.degraded if self._job is not None else False
+                )
+                out["pool"] = self._service.gauges()
+            return out
 
     def run_to_completion(self) -> None:
         """``POST /.runtocompletion`` (explorer.rs:178-187). Kicks the
@@ -209,6 +228,38 @@ class ExplorerApp:
                 results.append(view)
             return 200, results
 
+    def close(self) -> None:
+        """Releases this session's pool slot (``max_sessions`` admission).
+        ``serve()`` calls this at server shutdown; embedders that build
+        apps against a long-lived shared service must call it too, or the
+        session occupies a slot forever."""
+        if self._service is not None and self._job is not None:
+            self._service.release_interactive(self._job)
+
+    def pool(self) -> Tuple[int, Any]:
+        """``GET /.pool`` — the full service status surface (pool gauges +
+        per-job snapshots); 404 without a service."""
+        if self._service is None:
+            return 404, "no service attached"
+        return 200, self._service.metrics()
+
+    def job_trace(self, job_id: str) -> Tuple[int, Any]:
+        """``GET /.jobs/{id}/trace.json`` — the job's span trace as
+        Perfetto-loadable Chrome trace JSON (``obs.export_chrome``). A 200
+        body is the exported file's raw bytes (already JSON): the export
+        is mtime-cached service-side, and re-parsing it per poll just to
+        re-serialize would cost O(trace) each request."""
+        if self._service is None:
+            return 404, "no service attached"
+        try:
+            path = self._service.job_trace_chrome(job_id)
+        except KeyError:
+            return 404, f"unknown job {job_id}"
+        if path is None:
+            return 404, f"job {job_id} has no span trace"
+        with open(path, "rb") as fh:
+            return 200, fh.read()
+
     # --- helpers ----------------------------------------------------------
 
     def _properties(self) -> List[Tuple[str, str, Optional[str]]]:
@@ -263,7 +314,8 @@ def _pretty(state: Any) -> str:
         return repr(state)
 
 
-def serve(builder, addresses, engine: str = "auto", **spawn_kwargs):
+def serve(builder, addresses, engine: str = "auto", service=None,
+          **spawn_kwargs):
     """Starts the Explorer web service; blocks forever (checker.rs:137-144).
 
     ``addresses`` is a ``"host:port"`` string or ``(host, port)`` tuple.
@@ -271,11 +323,16 @@ def serve(builder, addresses, engine: str = "auto", **spawn_kwargs):
     oracle), ``"xla"`` (the device engine,
     :class:`~stateright_tpu.checker.device_on_demand.DeviceOnDemandChecker`),
     or ``"auto"`` — xla whenever the model is packed, like the reference
-    Explorer wrapping its real engine (explorer.rs:81-103). Returns the
-    checker (for tests that build the service without blocking, use
-    :func:`make_app`).
+    Explorer wrapping its real engine (explorer.rs:81-103). ``service`` is
+    the :class:`~stateright_tpu.service.CheckerService` pool to join as a
+    client (one is created when omitted); while its breaker is open,
+    ``"auto"``/``"xla"`` sessions degrade to the host engine with
+    ``degraded: true`` in ``/.status``. Returns the checker (for tests
+    that build the service without blocking, use :func:`make_app`).
     """
-    app, checker = make_app(builder, engine=engine, **spawn_kwargs)
+    app, checker = make_app(
+        builder, engine=engine, service=service, **spawn_kwargs
+    )
     host, port = _parse_address(addresses)
 
     class Handler(_ExplorerHandler):
@@ -289,18 +346,42 @@ def serve(builder, addresses, engine: str = "auto", **spawn_kwargs):
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        app.close()  # release the pool session slot
     return checker
 
 
-def make_app(builder, engine: str = "auto", **spawn_kwargs):
+def make_app(builder, engine: str = "auto", service=None, **spawn_kwargs):
     """Builds the Explorer app + demand-driven checker without binding a
     socket (the test entry point, mirroring explorer.rs:314-351). See
     :func:`serve` for ``engine``; ``spawn_kwargs`` reach the device
-    checker (capacities etc.)."""
+    checker (capacities etc.).
+
+    The checker is registered as one interactive job of ``service`` (a
+    default :class:`~stateright_tpu.service.CheckerService` when omitted —
+    construction is thread-free until batch jobs are submitted), so the
+    Explorer is admission-controlled (``AdmissionError`` past
+    ``max_sessions``) and the pool gauges ride in ``/.status``. With the
+    service's breaker open, device-engine requests are served DEGRADED on
+    the host on-demand engine — the service owns the device, and an open
+    breaker means it is not handing it to anyone."""
+    from ..service import CheckerService
     from ..xla import is_packed
 
+    if service is None:
+        service = CheckerService()
+    # Admission BEFORE construction: building the device backend allocates
+    # device-resident buffers, which is exactly the spend the session cap
+    # exists to gate.
+    service.check_session_capacity()
     snapshot = Snapshot()
-    if engine == "xla" or (engine == "auto" and is_packed(builder._model)):
+    degraded = False
+    wants_device = engine == "xla" or (
+        engine == "auto" and is_packed(builder._model)
+    )
+    if wants_device and service.degraded:
+        wants_device, degraded = False, True
+    if wants_device:
         from .device_on_demand import DeviceOnDemandChecker
 
         # The snapshot visitor would force one-level dispatches in batch
@@ -308,13 +389,16 @@ def make_app(builder, engine: str = "auto", **spawn_kwargs):
         # leaves the recent-path panel to the host backend.
         checker = DeviceOnDemandChecker(builder, **spawn_kwargs)
     else:
-        if spawn_kwargs:
+        if spawn_kwargs and not degraded:
             raise TypeError(
                 f"spawn kwargs {sorted(spawn_kwargs)} only apply to the "
                 "device engine; this model resolves to the host backend"
             )
+        # A degraded session silently drops the device-engine capacities —
+        # the host oracle has none to size.
         checker = builder.visitor(snapshot.visit).spawn_on_demand()
-    return ExplorerApp(checker, snapshot), checker
+    job = service.register_interactive(checker, degraded=degraded)
+    return ExplorerApp(checker, snapshot, service=service, job=job), checker
 
 
 def _rearm_loop(app: ExplorerApp) -> None:
@@ -359,6 +443,19 @@ class _ExplorerHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/.status":
             self._send_json(200, self.explorer_app.status())
+        elif path == "/.pool":
+            code, body = self.explorer_app.pool()
+            if code == 200:
+                self._send_json(200, body)
+            else:
+                self._send(code, str(body).encode(), "text/plain")
+        elif path.startswith("/.jobs/") and path.endswith("/trace.json"):
+            job_id = path[len("/.jobs/"):-len("/trace.json")]
+            code, body = self.explorer_app.job_trace(job_id)
+            if code == 200:
+                self._send(200, body, "application/json")
+            else:
+                self._send(code, str(body).encode(), "text/plain")
         elif path.startswith("/.states"):
             code, body = self.explorer_app.states(path[len("/.states"):])
             if code == 200:

@@ -178,11 +178,12 @@ def main(argv=None) -> None:
     from ..report import WriteReporter
 
     args = list(sys.argv[1:] if argv is None else argv)
+    orig_args = list(args)
     cmd = args.pop(0) if args else None
     if cmd in ("check", "check-xla"):
-        from ..backend import ensure_live_backend
+        from ..backend import guarded_main
 
-        ensure_live_backend()
+        guarded_main("stateright_tpu.models.increment", orig_args)
         thread_count = int(args.pop(0)) if args else 3
         print(f"Model checking increment with {thread_count} threads on XLA.")
         PackedIncrement(thread_count).checker().spawn_xla(

@@ -1284,6 +1284,7 @@ def main(argv=None) -> None:
     from ..report import WriteReporter
 
     args = list(sys.argv[1:] if argv is None else argv)
+    orig_args = list(args)
     cmd = args.pop(0) if args else None
     if cmd in ("check", "check-xla"):
         # ``check`` runs the device (XLA) engine on the packed ABD model —
@@ -1294,16 +1295,20 @@ def main(argv=None) -> None:
         # oracle at the reference CLI's 3-server shape.
         client_count = int(args.pop(0)) if args else 2
         netname = args.pop(0) if args else None
-        network = Network.from_name(netname) if netname else None
-        # "unordered_nonduplicating" IS the packed models' default network:
-        # spelling it out must route to the same device check as omitting
-        # it, not change the shape under the user (ADVICE r4).
+        # "unordered" / "unordered_nonduplicating" both spell the packed
+        # models' default network: naming the default explicitly must
+        # route to the SAME device check as omitting it — never a
+        # different engine/state space under the user (ADVICE r4).
+        if netname == "unordered":
+            netname = "unordered_nonduplicating"
         if client_count in (2, 3) and netname in (
             None, "unordered_nonduplicating", "ordered",
         ):
-            from ..backend import ensure_live_backend
+            from ..backend import guarded_main
 
-            ensure_live_backend()
+            guarded_main(
+                "stateright_tpu.models.linearizable_register", orig_args
+            )
             cls = PackedAbdOrdered if netname == "ordered" else PackedAbd
             print(
                 f"Model checking a linearizable register with {client_count} "
@@ -1317,9 +1322,10 @@ def main(argv=None) -> None:
                 .report(WriteReporter())
             )
         else:
+            network = Network.from_name(netname) if netname else None
             print(
                 f"Model checking a linearizable register with {client_count} "
-                "clients."
+                "clients (host oracle, reference CLI 3-server shape)."
             )
             (
                 linearizable_register_model(client_count, 3, network)
@@ -1377,7 +1383,10 @@ def main(argv=None) -> None:
         print("  linearizable-register check-xla   (alias of check)")
         print("  linearizable-register explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  linearizable-register spawn")
-        print(f"NETWORK: {' | '.join(Network.names())}")
+        print(
+            f"NETWORK: {' | '.join(Network.names())}"
+            "  ('unordered' = unordered_nonduplicating, the packed default)"
+        )
 
 
 if __name__ == "__main__":

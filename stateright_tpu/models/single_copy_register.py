@@ -632,6 +632,7 @@ def main(argv=None) -> None:
     from ..report import WriteReporter
 
     args = list(sys.argv[1:] if argv is None else argv)
+    orig_args = list(args)
     cmd = args.pop(0) if args else None
     if cmd in ("check", "check-xla"):
         # ``check`` runs the device (XLA) engine — the reference's check
@@ -640,9 +641,9 @@ def main(argv=None) -> None:
         client_count = int(args.pop(0)) if args and args[0].isdigit() else 2
         netname = args.pop(0) if args else None
         if netname in (None, "ordered"):
-            from ..backend import ensure_live_backend
+            from ..backend import guarded_main
 
-            ensure_live_backend()
+            guarded_main("stateright_tpu.models.single_copy_register", orig_args)
             print(
                 f"Model checking a single-copy register with {client_count} "
                 "clients on XLA."

@@ -337,11 +337,12 @@ def main(argv=None) -> None:
     from ..report import WriteReporter
 
     args = list(sys.argv[1:] if argv is None else argv)
+    orig_args = list(args)
     cmd = args.pop(0) if args else None
     if cmd in ("check", "check-xla"):
-        from ..backend import ensure_live_backend
+        from ..backend import guarded_main
 
-        ensure_live_backend()
+        guarded_main("stateright_tpu.models.two_phase_commit", orig_args)
         rm_count = int(args.pop(0)) if args else 2
         print(
             f"Checking two phase commit with {rm_count} resource managers "

@@ -1,0 +1,29 @@
+"""Checking-as-a-service: a multi-tenant pool over one device.
+
+:class:`CheckerService` owns the device and serves N concurrent checking
+jobs — batch jobs in supervised worker subprocesses (per-job heartbeat,
+auto-checkpoint, span trace; a wedge quarantines one job, never the pool)
+and interactive Explorer sessions as registered in-process clients —
+behind admission control, with a breaker that degrades the pool to the
+host engine instead of dying. See ``docs/service.md``; chaos pins in
+``tests/test_service.py``.
+"""
+
+from .core import (
+    SERVICE_COUNTERS,
+    AdmissionError,
+    CheckerService,
+    Job,
+    ServiceConfig,
+)
+from .registry import SHIPPED, resolve
+
+__all__ = [
+    "AdmissionError",
+    "CheckerService",
+    "Job",
+    "SERVICE_COUNTERS",
+    "ServiceConfig",
+    "SHIPPED",
+    "resolve",
+]

@@ -1,0 +1,861 @@
+"""CheckerService: fault-isolated multi-tenant checking on one device.
+
+ROADMAP item 3's production framing ("millions of users": one chip, many
+concurrent interactive sessions and batch jobs) composed from the recovery
+primitives PR 3 built for *one* run (``supervise.run_worker`` heartbeat
+verdicts, atomic rotating checkpoints) into a pool where faults are
+isolated per job and the pool degrades instead of dying:
+
+- **Admission control** — bounded in-flight jobs and a bounded queue;
+  beyond either, :meth:`CheckerService.submit` raises the typed
+  :class:`AdmissionError` carrying ``retry_after_s`` (the ``Retry-After``
+  value an HTTP front end would send) instead of queueing unboundedly.
+  Per-job budgets: wall-clock (``max_seconds``, soft-checked in the worker
+  at quiescent points, hard-backstopped by the supervisor) and state count
+  (``max_states`` via ``target_state_count``), both clamped by pool caps.
+- **Per-job fault isolation** — every device job runs
+  ``service/worker.py`` in its own process group under
+  ``supervise.run_worker`` with its *own* heartbeat, span trace, and
+  auto-checkpoint rotation set under the service's run dir. A wedge
+  verdict (heartbeat stale mid-dispatch — the tunnel signature) kills
+  exactly that job's group, **quarantines** the job for an exponential
+  backoff, and requeues it resuming from its latest valid checkpoint
+  rotation; sibling jobs never see it. A worker that dies by signal
+  (crash) requeues the same way but is not evidence against the device.
+- **Graceful degradation** — ``breaker_k`` *consecutive* device wedge
+  verdicts (any job) trip a breaker: new and requeued jobs route to the
+  host on-demand engine (``checker/on_demand.py``) on the CPU backend with
+  ``degraded: true`` in their status — slower, but no tunnel to wedge. A
+  background prober (a watchdogged subprocess, so the service process
+  itself never touches jax) re-probes the device and closes the breaker.
+- **Status surface** — :meth:`metrics` snapshots pool gauges
+  (queued/running/quarantined/interactive, breaker state, wedge/requeue
+  counters through the obs registry) plus per-job summaries; each job's
+  span trace exports as a Perfetto-loadable Chrome trace via
+  :meth:`job_trace_chrome` (reusing ``obs.export_chrome``). The Explorer
+  is one client: ``make_app``/``serve`` register their interactive checker
+  as a pool job and embed the gauges in ``/.status``.
+
+Like the supervisor it builds on, importing this module never imports jax
+— the service process stays wedge-proof; only workers and the prober (both
+subprocesses) touch a backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import supervise as sup
+from ..checkpoint import latest_valid_checkpoint
+from ..obs import Counters, export_chrome
+from . import registry
+
+#: Pre-seeded pool counters (stable ``metrics()`` key set, like the
+#: engines' ENGINE_COUNTERS; docs/service.md).
+SERVICE_COUNTERS = (
+    "submitted",
+    "admitted",
+    "rejected",
+    "jobs_done",
+    "jobs_failed",
+    "wedge_verdicts",
+    "crashes",
+    "requeues",
+    "breaker_trips",
+    "breaker_closes",
+    "degraded_jobs",
+    "device_probes",
+)
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
+
+
+class AdmissionError(Exception):
+    """Typed admission rejection. ``retry_after_s`` is the back-pressure
+    hint (an HTTP front end's ``Retry-After``); None when retrying cannot
+    help (a budget above the pool cap)."""
+
+    def __init__(self, reason: str, retry_after_s: Optional[float] = None):
+        msg = reason
+        if retry_after_s is not None:
+            msg += f" (retry after ~{retry_after_s:.0f}s)"
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class ServiceConfig:
+    """Pool knobs; everything has a production-shaped default and the chaos
+    tests shrink the time constants."""
+
+    run_dir: str = os.path.join("runs", "service")
+    # -- admission ---------------------------------------------------------
+    max_inflight: int = 2  #: concurrently running batch jobs
+    max_queue: int = 8  #: queued + quarantined jobs beyond the running set
+    max_sessions: int = 4  #: interactive (Explorer) clients
+    default_max_seconds: float = 600.0
+    max_seconds_cap: float = 3600.0
+    max_states_cap: Optional[int] = None
+    block_size: int = 1500  #: host-engine block granularity (on_demand.py)
+    # -- supervision (supervise.run_worker) --------------------------------
+    stall_s: float = 1200.0
+    startup_grace_s: float = 900.0
+    poll_s: float = 0.5
+    requeue_limit: int = 2  #: wedge/crash requeues per job before it fails
+    backoff_s: float = 5.0  #: quarantine backoff base (exponential)
+    # -- breaker -----------------------------------------------------------
+    breaker_k: int = 3  #: consecutive wedge verdicts that trip it
+    probe_auto: bool = True  #: background re-probe while open
+    probe_interval_s: float = 60.0
+    probe_timeout_s: float = 45.0
+    #: Device-liveness probe command (rc 0 = device healthy). The default
+    #: pays full plugin init in a throwaway subprocess, exactly like
+    #: ``backend.ensure_live_backend``'s probe.
+    probe_argv: Optional[Sequence[str]] = None
+    # -- workers -----------------------------------------------------------
+    platform: str = "default"  #: "default" (accelerator) | "cpu" (tests)
+    compile_cache: Optional[str] = None  #: default: <cwd>/.jax_cache
+    checkpoint_every: Any = 1  #: per-job auto-checkpoint cadence
+    checkpoint_keep: int = 3
+
+
+class Job:
+    """One pool entry. Batch jobs own a job dir (checkpoints, heartbeat,
+    trace, worker stdout); interactive jobs wrap a live in-process checker.
+    All mutation happens under the service lock."""
+
+    def __init__(
+        self,
+        service: "CheckerService",
+        job_id: str,
+        spec: str,
+        *,
+        kind: str = "batch",
+        max_seconds: float = 600.0,
+        max_states: Optional[int] = None,
+        chaos: Optional[Dict[str, Any]] = None,
+    ):
+        self._service = service
+        self.id = job_id
+        self.spec = spec
+        self.kind = kind  #: "batch" | "interactive"
+        self.status = "queued"  #: queued|running|quarantined|done|failed
+        self.engine = "xla"  #: engine of the current/last attempt
+        self.degraded = False  #: served by the host fallback
+        self.max_seconds = max_seconds
+        self.max_states = max_states
+        self.chaos = chaos or {}
+        self.attempts: List[Dict[str, Any]] = []
+        self.wedges = 0
+        self.requeues = 0
+        self.consumed_s = 0.0
+        self.requeue_at = 0.0  #: monotonic; quarantine release time
+        self.resumed_from: Optional[str] = None  #: last attempt's resume
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[str] = None
+        self.created_unix_ts = time.time()
+        self.checker = None  #: interactive jobs only
+        self.dir: Optional[str] = None
+        self._proc = None  #: live worker Popen (close-with-kill path)
+
+    # -- paths -------------------------------------------------------------
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    @property
+    def checkpoint_path(self) -> str:
+        return self._path("ck.npz")
+
+    @property
+    def trace_path(self) -> str:
+        return self._path("trace.jsonl")
+
+    # -- surface -----------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self.status in ("done", "failed")
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Blocks until the job reaches a terminal state; returns whether
+        it did within ``timeout``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._service._cond:
+            while not self.done:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._service._cond.wait(timeout=remaining)
+        return True
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The per-job status record (pool ``metrics()["jobs"]`` entry)."""
+        out = {
+            "id": self.id,
+            "kind": self.kind,
+            "spec": self.spec,
+            "status": self.status,
+            "engine": self.engine,
+            "degraded": self.degraded,
+            "wedges": self.wedges,
+            "requeues": self.requeues,
+            "attempts": len(self.attempts),
+            "resumed_from": self.resumed_from,
+            "error": self.error,
+        }
+        if self.result is not None:
+            out["result"] = {
+                k: self.result.get(k)
+                for k in ("generated", "unique", "max_depth", "seconds")
+            }
+        return out
+
+    def metrics(self) -> Optional[Dict[str, Any]]:
+        """The per-job engine snapshot: a finished batch job's recorded
+        ``metrics()``, or a live poll of an interactive checker."""
+        if self.checker is not None:
+            return self.checker.metrics()
+        if self.result is not None:
+            return self.result.get("metrics")
+        return None
+
+
+class CheckerService:
+    """The device's owner: N concurrent checking jobs behind admission
+    control, per-job supervision, and a degradation breaker. Construction
+    is cheap (no threads, no dirs) — the scheduler thread starts on the
+    first :meth:`submit`, the prober when the breaker opens."""
+
+    def __init__(self, config: Optional[ServiceConfig] = None, **overrides):
+        if config is not None and overrides:
+            raise TypeError(
+                "pass either a ServiceConfig or keyword overrides, not both "
+                f"(got config and {sorted(overrides)})"
+            )
+        self._cfg = config or ServiceConfig(**overrides)
+        if self._cfg.compile_cache is None:
+            self._cfg.compile_cache = os.path.abspath(".jax_cache")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._jobs: Dict[str, Job] = {}
+        self._order: List[str] = []
+        self._counters = Counters(SERVICE_COUNTERS)
+        self._breaker = "closed"  #: "closed" | "open"
+        self._consecutive_wedges = 0
+        self._breaker_opened_unix_ts: Optional[float] = None
+        self._closed = False
+        self._next_id = 0
+        self._scheduler: Optional[threading.Thread] = None
+        self._prober: Optional[threading.Thread] = None
+        self._session_dir: Optional[str] = None
+        self.log = lambda msg: None  #: swap in print for a chatty service
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "CheckerService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def close(self, kill: bool = True, timeout: float = 10.0) -> None:
+        """Stops scheduling and the prober; with ``kill`` (default), kills
+        any in-flight worker process groups (their jobs read as failed).
+        Every non-terminal job reaches a terminal state here — a waiter
+        blocked in ``Job.wait()``/``wait_all()`` must wake to a verdict,
+        never hang on a queue that will no longer be scheduled."""
+        with self._cond:
+            self._closed = True
+            procs = [
+                j._proc
+                for j in self._jobs.values()
+                if j._proc is not None and j._proc.poll() is None
+            ]
+            for j in self._jobs.values():
+                # Running batch jobs are settled by their _run_job thread
+                # (it re-checks _closed under the lock); interactive jobs
+                # just end with the pool.
+                if j.status in ("queued", "quarantined"):
+                    j.status = "failed"
+                    j.error = "service closed"
+                    self._counters.inc("jobs_failed")
+                elif j.kind == "interactive" and j.status == "running":
+                    j.status = "done"
+                    self._counters.inc("jobs_done")
+            self._cond.notify_all()
+        if kill:
+            for proc in procs:
+                sup._kill_group(proc)
+        for t in (self._scheduler, self._prober):
+            if t is not None:
+                t.join(timeout=timeout)
+
+    def _ensure_session_dir(self) -> str:
+        if self._session_dir is None:
+            d = os.path.join(
+                self._cfg.run_dir, f"svc-{int(time.time())}-{os.getpid()}"
+            )
+            os.makedirs(d, exist_ok=True)
+            self._session_dir = d
+        return self._session_dir
+
+    def _ensure_scheduler(self) -> None:
+        if self._scheduler is None or not self._scheduler.is_alive():
+            self._scheduler = threading.Thread(
+                target=self._scheduler_loop, name="stpu-service-scheduler",
+                daemon=True,
+            )
+            self._scheduler.start()
+
+    # -- admission ---------------------------------------------------------
+
+    def _counts(self) -> Dict[str, int]:
+        c = {"queued": 0, "running": 0, "quarantined": 0, "interactive": 0,
+             "done": 0, "failed": 0}
+        for j in self._jobs.values():
+            if j.kind == "interactive":
+                if j.status == "running":
+                    c["interactive"] += 1
+                continue
+            c[j.status] += 1
+        return c
+
+    def _retry_after(self, counts: Dict[str, int]) -> float:
+        """The back-pressure estimate: jobs ahead, amortized over the
+        in-flight slots at the default budget. An estimate, not a promise
+        — but monotone in pool pressure, which is what a client's retry
+        loop needs."""
+        ahead = counts["queued"] + counts["quarantined"] + counts["running"]
+        per_slot = ahead / max(self._cfg.max_inflight, 1)
+        return min(
+            max(10.0, per_slot * self._cfg.default_max_seconds * 0.5),
+            self._cfg.max_seconds_cap,
+        )
+
+    def submit(
+        self,
+        spec: str,
+        *,
+        max_seconds: Optional[float] = None,
+        max_states: Optional[int] = None,
+        chaos: Optional[Dict[str, Any]] = None,
+    ) -> Job:
+        """Queues one batch checking job; returns its :class:`Job` handle
+        or raises :class:`AdmissionError` (queue full → carries
+        ``retry_after_s``; an over-cap budget → no retry hint, shrink the
+        request). Unknown/malformed specs raise ``ValueError`` before any
+        admission accounting."""
+        registry.parse(spec)  # typed spec validation, pre-admission
+        max_seconds = (
+            self._cfg.default_max_seconds if max_seconds is None else max_seconds
+        )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("service is closed")
+            self._counters.inc("submitted")
+            if not 0 < max_seconds <= self._cfg.max_seconds_cap:
+                self._counters.inc("rejected")
+                raise AdmissionError(
+                    f"max_seconds {max_seconds:.0f} outside the servable "
+                    f"range (0, {self._cfg.max_seconds_cap:.0f}]"
+                )
+            if (
+                self._cfg.max_states_cap is not None
+                and max_states is not None
+                and max_states > self._cfg.max_states_cap
+            ):
+                self._counters.inc("rejected")
+                raise AdmissionError(
+                    f"max_states {max_states} exceeds the pool cap "
+                    f"{self._cfg.max_states_cap}"
+                )
+            counts = self._counts()
+            if counts["queued"] + counts["quarantined"] >= self._cfg.max_queue:
+                self._counters.inc("rejected")
+                raise AdmissionError(
+                    f"queue full ({self._cfg.max_queue} waiting jobs)",
+                    retry_after_s=self._retry_after(counts),
+                )
+            self._next_id += 1
+            job = Job(
+                self,
+                f"job-{self._next_id:04d}",
+                spec,
+                max_seconds=max_seconds,
+                max_states=max_states,
+                chaos=chaos,
+            )
+            job.dir = os.path.join(self._ensure_session_dir(), job.id)
+            os.makedirs(job.dir, exist_ok=True)
+            self._jobs[job.id] = job
+            self._order.append(job.id)
+            self._counters.inc("admitted")
+            self._ensure_scheduler()
+            self._cond.notify_all()
+        return job
+
+    def check_session_capacity(self) -> None:
+        """Raises :class:`AdmissionError` when the interactive-session cap
+        is already reached. Callers building EXPENSIVE checkers (the
+        Explorer's device backend allocates device-resident buffers) call
+        this *before* construction so a rejected tenant never pays — the
+        small pre-check-to-register window is benign (register still
+        enforces the cap). A rejection here counts as submitted+rejected —
+        capacity-rejected sessions must be visible in the pool telemetry,
+        and ``submitted == admitted + rejected`` stays reconcilable (a
+        passing pre-check counts nothing; registration does)."""
+        with self._lock:
+            counts = self._counts()
+            if counts["interactive"] >= self._cfg.max_sessions:
+                self._counters.inc("submitted")
+                self._counters.inc("rejected")
+                raise AdmissionError(
+                    f"interactive sessions full ({self._cfg.max_sessions})",
+                    retry_after_s=self._retry_after(counts),
+                )
+
+    def register_interactive(self, checker, *, label: Optional[str] = None,
+                             degraded: bool = False) -> Job:
+        """Admits a live in-process checker (the Explorer's) as a pool job
+        of kind ``"interactive"`` — counted, capped (``max_sessions``),
+        and visible in the pool gauges like any other tenant."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("service is closed")
+            self._counters.inc("submitted")
+            counts = self._counts()
+            if counts["interactive"] >= self._cfg.max_sessions:
+                self._counters.inc("rejected")
+                raise AdmissionError(
+                    f"interactive sessions full ({self._cfg.max_sessions})",
+                    retry_after_s=self._retry_after(counts),
+                )
+            self._next_id += 1
+            job = Job(
+                self,
+                f"job-{self._next_id:04d}",
+                label or type(checker.model()).__name__,
+                kind="interactive",
+            )
+            job.status = "running"
+            job.engine = "host" if degraded else "xla"
+            job.degraded = degraded
+            job.checker = checker
+            if degraded:
+                self._counters.inc("degraded_jobs")
+            self._jobs[job.id] = job
+            self._order.append(job.id)
+            self._counters.inc("admitted")
+            self._cond.notify_all()
+        checker.attach_job(job.id)
+        return job
+
+    def release_interactive(self, job: Job) -> None:
+        with self._cond:
+            if job.status == "running":
+                job.status = "done"
+                self._counters.inc("jobs_done")
+            self._cond.notify_all()
+
+    # -- scheduling --------------------------------------------------------
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            to_start: List[Job] = []
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                counts = self._counts()
+                slots = self._cfg.max_inflight - counts["running"]
+                quarantine_release = None
+                if slots > 0:
+                    for jid in self._order:
+                        job = self._jobs[jid]
+                        if job.kind != "batch":
+                            continue
+                        if job.status == "quarantined" and job.requeue_at > now:
+                            quarantine_release = (
+                                job.requeue_at
+                                if quarantine_release is None
+                                else min(quarantine_release, job.requeue_at)
+                            )
+                            continue
+                        if job.status in ("queued", "quarantined"):
+                            job.status = "running"
+                            to_start.append(job)
+                            slots -= 1
+                            if slots == 0:
+                                break
+                if not to_start:
+                    # Event-driven idle: submit/requeue/close all notify.
+                    # A timed wait is only needed to release a quarantine
+                    # backoff (or re-poll a full pool) — an idle pool
+                    # sleeps on the condition instead of polling at 5 Hz
+                    # on this one-core box.
+                    if quarantine_release is not None:
+                        self._cond.wait(
+                            timeout=max(quarantine_release - now, 0.05)
+                        )
+                    else:
+                        # Idle or full pool: every relevant transition
+                        # (submit, requeue, job settlement, close)
+                        # notifies, so an untimed wait suffices.
+                        self._cond.wait()
+            for job in to_start:
+                threading.Thread(
+                    target=self._run_job, args=(job,),
+                    name=f"stpu-service-{job.id}", daemon=True,
+                ).start()
+
+    def _worker_env(self, job: Job, device: bool) -> Dict[str, str]:
+        env = dict(os.environ)
+        # Scrub inherited run-trace/recovery env: per-job artifacts must
+        # never alias an outer run's files.
+        for key in (
+            "STPU_TRACE", "STPU_TRACE_CHROME", "STPU_HEARTBEAT",
+            "STPU_CHECKPOINT_TO", "STPU_CHECKPOINT_EVERY",
+            "STPU_CHECKPOINT_KEEP",
+        ):
+            env.pop(key, None)
+        if device:
+            env["STPU_TRACE"] = job.trace_path
+        env["STPU_COMPILE_CACHE"] = self._cfg.compile_cache
+        return env
+
+    def _run_job(self, job: Job) -> None:
+        """One supervised attempt of ``job``; classification + requeue
+        decisions happen under the lock afterwards. Any unexpected
+        exception settles the job as failed — a job stuck in "running"
+        with no thread behind it would consume a ``max_inflight`` slot
+        forever and hang its waiters."""
+        try:
+            self._run_job_inner(job)
+        except Exception as e:  # noqa: BLE001 - the verdict IS the handling
+            with self._cond:
+                job._proc = None
+                job.status = "failed"
+                job.error = f"supervisor error: {type(e).__name__}: {e}"
+                self._counters.inc("jobs_failed")
+                self._cond.notify_all()
+
+    def _run_job_inner(self, job: Job) -> None:
+        cfg = self._cfg
+        attempt = len(job.attempts)
+        device = self._breaker == "closed"
+        engine = "xla" if device else "host"
+        remaining = job.max_seconds - job.consumed_s
+        if remaining <= 0:
+            with self._cond:
+                job.status = "failed"
+                job.error = "wall-clock budget exhausted"
+                self._counters.inc("jobs_failed")
+                self._cond.notify_all()
+            return
+        resume = (
+            latest_valid_checkpoint(job.checkpoint_path) if device else None
+        )
+        argv = [
+            sys.executable, _WORKER,
+            "--spec", job.spec,
+            "--engine", engine,
+            "--platform", cfg.platform if device else "cpu",
+            "--out", job._path("result.json"),
+            "--block-size", str(cfg.block_size),
+            "--max-seconds", str(remaining),
+        ]
+        if device:
+            argv += [
+                "--checkpoint", job.checkpoint_path,
+                "--every", str(cfg.checkpoint_every),
+                "--keep", str(cfg.checkpoint_keep),
+            ]
+            if resume:
+                argv += ["--resume", resume]
+        if job.max_states:
+            argv += ["--max-states", str(job.max_states)]
+        for flag, key in (
+            ("--chaos-die-at-depth", "die_at_depth"),
+            ("--chaos-freeze-at-depth", "freeze_at_depth"),
+            ("--chaos-marker", "marker"),
+        ):
+            if job.chaos.get(key) is not None:
+                argv += [flag, str(job.chaos[key])]
+
+        def on_spawn(proc):
+            # close() snapshots live procs under the lock; a worker that
+            # spawns in the close race is killed HERE instead of running
+            # unsupervised for its whole budget after the pool is gone.
+            with self._cond:
+                job._proc = proc
+                closed = self._closed
+            if closed:
+                sup._kill_group(proc)
+
+        with self._cond:
+            if self._closed:
+                job.status = "failed"
+                job.error = "service closed"
+                self._counters.inc("jobs_failed")
+                self._cond.notify_all()
+                return
+            job.engine = engine
+            job.resumed_from = resume
+            if not device:
+                job.degraded = True
+        self.log(f"{job.id} attempt {attempt} engine={engine} resume={resume}")
+        res = sup.run_worker(
+            argv,
+            heartbeat=job._path("hb.json") if device else None,
+            # Verdict ordering contract: the worker's soft budget exit
+            # (rc 3) fires first; a wedge that starts ANY time inside the
+            # budget draws its heartbeat-staleness verdict (<= stall_s x
+            # the 3x compile leash after onset) before the hard timeout,
+            # which only backstops a worker that can neither reach a
+            # quiescent point nor be diagnosed by heartbeat. Without the
+            # stall headroom here, a production-default pool (600s budget,
+            # 1200s stall) would misread every wedge as budget exhaustion
+            # — no requeue, no breaker evidence.
+            timeout_s=remaining * 1.5 + 60.0 + cfg.stall_s * 3.0,
+            stall_s=cfg.stall_s,
+            startup_grace_s=cfg.startup_grace_s,
+            poll_s=cfg.poll_s,
+            env=self._worker_env(job, device),
+            stdout_path=job._path(f"worker{attempt}.out"),
+            log=self.log,
+            on_spawn=on_spawn,
+        )
+        result = None
+        if res.ok:
+            try:
+                with open(job._path("result.json")) as fh:
+                    result = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                result = None
+        with self._cond:
+            job._proc = None
+            # Wedge time is the DEVICE's fault, not the tenant's demand:
+            # charging it would make the requeued attempt start with a
+            # drained budget and fail as "budget exhausted" instead of
+            # resuming. Crashes still charge — the compute was real and
+            # checkpointed.
+            if not res.wedged:
+                job.consumed_s += res.seconds
+            job.attempts.append(
+                {
+                    "rc": res.rc,
+                    "killed": res.killed,
+                    "seconds": res.seconds,
+                    "engine": engine,
+                    "wedged": res.wedged,
+                    "resumed_from": resume,
+                }
+            )
+            if self._closed:
+                job.status = "failed"
+                job.error = "service closed"
+                self._counters.inc("jobs_failed")
+                self._cond.notify_all()
+                return
+            if result is not None:
+                job.status = "done"
+                job.result = result
+                if result.get("degraded"):
+                    job.degraded = True
+                    self._counters.inc("degraded_jobs")
+                self._counters.inc("jobs_done")
+                if device:
+                    self._consecutive_wedges = 0
+            elif res.wedged:
+                self._counters.inc("wedge_verdicts")
+                job.wedges += 1
+                self._record_wedge()
+                self._requeue_or_fail(job, f"wedge verdict: {res.killed}")
+            elif res.crashed:
+                self._counters.inc("crashes")
+                self._requeue_or_fail(
+                    job, f"worker died by signal (rc={res.rc})"
+                )
+            elif res.killed is not None or res.rc == 3:
+                job.status = "failed"
+                job.error = "wall-clock budget exhausted"
+                self._counters.inc("jobs_failed")
+            else:
+                job.status = "failed"
+                job.error = f"worker exited rc={res.rc}"
+                self._counters.inc("jobs_failed")
+            self._cond.notify_all()
+
+    def _requeue_or_fail(self, job: Job, reason: str) -> None:
+        """Quarantine-and-requeue with exponential backoff, up to the
+        requeue limit. Caller holds the lock."""
+        if job.requeues < self._cfg.requeue_limit:
+            job.requeues += 1
+            self._counters.inc("requeues")
+            job.status = "quarantined"
+            job.requeue_at = time.monotonic() + sup.backoff_delay(
+                job.requeues, self._cfg.backoff_s
+            )
+            self.log(f"{job.id} quarantined ({reason})")
+        else:
+            job.status = "failed"
+            job.error = f"{reason}; requeue limit reached"
+            self._counters.inc("jobs_failed")
+
+    # -- breaker -----------------------------------------------------------
+
+    def _record_wedge(self) -> None:
+        """Caller holds the lock."""
+        self._consecutive_wedges += 1
+        if (
+            self._breaker == "closed"
+            and self._consecutive_wedges >= self._cfg.breaker_k
+        ):
+            self._breaker = "open"
+            self._breaker_opened_unix_ts = time.time()
+            self._counters.inc("breaker_trips")
+            self.log(
+                f"breaker OPEN after {self._consecutive_wedges} consecutive "
+                "wedge verdicts; routing jobs to the host engine"
+            )
+            if self._cfg.probe_auto:
+                self._prober = threading.Thread(
+                    target=self._probe_loop, name="stpu-service-prober",
+                    daemon=True,
+                )
+                self._prober.start()
+
+    @property
+    def degraded(self) -> bool:
+        """Whether the breaker is open (new work routes to the host
+        engine)."""
+        return self._breaker == "open"
+
+    def probe_device_now(self) -> bool:
+        """One device-liveness probe (a watchdogged subprocess — the
+        service process never touches jax); on success while the breaker
+        is open, closes it. The background prober calls this on
+        ``probe_interval_s``; tests and operators call it directly."""
+        argv = list(
+            self._cfg.probe_argv
+            or [sys.executable, "-c", "import jax; jax.devices()"]
+        )
+        with self._lock:  # Counters.inc is not atomic; every mutation locks
+            self._counters.inc("device_probes")
+        try:
+            rc = subprocess.run(
+                argv,
+                timeout=self._cfg.probe_timeout_s,
+                capture_output=True,
+            ).returncode
+        except (subprocess.TimeoutExpired, OSError):
+            rc = None
+        ok = rc == 0
+        with self._cond:
+            if ok and self._breaker == "open":
+                self._breaker = "closed"
+                self._breaker_opened_unix_ts = None
+                self._consecutive_wedges = 0
+                self._counters.inc("breaker_closes")
+                self.log("breaker CLOSED (device probe healthy)")
+                self._cond.notify_all()
+        return ok
+
+    def _probe_loop(self) -> None:
+        while True:
+            deadline = time.monotonic() + self._cfg.probe_interval_s
+            with self._cond:
+                while not self._closed and time.monotonic() < deadline:
+                    if self._breaker == "closed":
+                        return
+                    self._cond.wait(timeout=min(
+                        1.0, deadline - time.monotonic()
+                    ))
+                if self._closed or self._breaker == "closed":
+                    return
+            self.probe_device_now()
+
+    # -- status surface ----------------------------------------------------
+
+    def job(self, job_id: str) -> Job:
+        return self._jobs[job_id]
+
+    def jobs(self) -> List[Job]:
+        with self._lock:
+            return [self._jobs[jid] for jid in self._order]
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        """Blocks until every batch job is terminal."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while any(
+                not j.done for j in self._jobs.values() if j.kind == "batch"
+            ):
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    def gauges(self) -> Dict[str, Any]:
+        """The pool-wide snapshot without per-job payloads — what the
+        Explorer embeds under ``/.status``'s ``"pool"`` key."""
+        with self._lock:
+            counts = self._counts()
+            return {
+                **counts,
+                "max_inflight": self._cfg.max_inflight,
+                "max_queue": self._cfg.max_queue,
+                "max_sessions": self._cfg.max_sessions,
+                "breaker": {
+                    "state": self._breaker,
+                    "consecutive_wedges": self._consecutive_wedges,
+                    "k": self._cfg.breaker_k,
+                    "opened_unix_ts": self._breaker_opened_unix_ts,
+                },
+                **self._counters.snapshot(),
+            }
+
+    def metrics(self) -> Dict[str, Any]:
+        """Pool gauges plus per-job status snapshots (the full service
+        status surface; per-job engine metrics via ``Job.metrics()``)."""
+        out = self.gauges()
+        with self._lock:
+            out["jobs"] = {
+                jid: self._jobs[jid].snapshot() for jid in self._order
+            }
+        return out
+
+    def job_trace_chrome(self, job_id: str,
+                         out_path: Optional[str] = None) -> Optional[str]:
+        """Exports a job's span trace as Perfetto-loadable Chrome trace
+        JSON (``obs.export_chrome``); returns the output path, or None when
+        the job never produced a trace (host-engine jobs don't)."""
+        job = self._jobs[job_id]
+        if job.dir is None or not os.path.exists(job.trace_path):
+            return None
+        dst = out_path or job._path("trace.chrome.json")
+        try:
+            fresh = os.stat(dst).st_mtime >= os.stat(job.trace_path).st_mtime
+        except OSError:
+            fresh = False
+        if not fresh:
+            # Re-export only when the append-only source advanced — a
+            # polled trace endpoint must not re-parse the whole JSONL per
+            # request.
+            export_chrome(job.trace_path, dst)
+        return dst

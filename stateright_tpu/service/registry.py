@@ -1,0 +1,134 @@
+"""Model registry: the specs a :class:`CheckerService` job can name.
+
+Service jobs run in supervised subprocesses (fault isolation — a wedged
+tunnel or a runaway model takes down one worker's process group, never the
+pool), so a job's model must be constructible from a plain string the
+worker re-resolves on its side of the boundary. Spec grammar::
+
+    <family>[:<arg>[,<arg>...]]
+
+e.g. ``2pc:4``, ``paxos:2,3``, ``abd-ordered:2``, ``scr:3,1``. Omitted
+args take the family default. :func:`resolve` returns the packed model
+plus the engine capacities the shipped configurations are tuned at (the
+same anchors bench.py's matrix pins) — callers may override capacities,
+but identical capacities replay identical (shape, bucket) schedules and so
+hit the persistent XLA compile cache (``tools/warm_cache.py`` pre-seeds it
+for exactly the :data:`SHIPPED` list below).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+
+def _two_phase(args: List[int]):
+    from ..models.two_phase_commit import PackedTwoPhaseSys
+
+    rm = args[0] if args else 3
+    return PackedTwoPhaseSys(rm), dict(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+
+
+def _paxos(args: List[int]):
+    from ..models.paxos import PackedPaxos
+
+    c = args[0] if len(args) > 0 else 2
+    s = args[1] if len(args) > 1 else 3
+    return PackedPaxos(c, s), dict(
+        frontier_capacity=1 << 12, table_capacity=1 << 16
+    )
+
+
+def _abd(args: List[int]):
+    from ..models.linearizable_register import PackedAbd
+
+    c = args[0] if args else 2
+    return PackedAbd(c, 2), dict(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+
+
+def _abd_ordered(args: List[int]):
+    from ..models.linearizable_register import PackedAbdOrdered
+
+    c = args[0] if args else 2
+    return PackedAbdOrdered(c, 2), dict(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+
+
+def _scr(args: List[int]):
+    from ..models.single_copy_register import PackedSingleCopyRegister
+
+    c = args[0] if len(args) > 0 else 3
+    s = args[1] if len(args) > 1 else 1
+    return PackedSingleCopyRegister(c, s), dict(
+        frontier_capacity=1 << 11, table_capacity=1 << 14
+    )
+
+
+def _increment(args: List[int]):
+    from ..models.increment import PackedIncrement
+
+    t = args[0] if args else 3
+    return PackedIncrement(t), dict(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+
+
+def _increment_lock(args: List[int]):
+    from ..models.increment_lock import PackedIncrementLock
+
+    t = args[0] if args else 3
+    return PackedIncrementLock(t), dict(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+
+
+#: family name -> model factory taking the parsed integer args.
+FAMILIES: Dict[str, Callable[[List[int]], Tuple[Any, Dict[str, int]]]] = {
+    "2pc": _two_phase,
+    "paxos": _paxos,
+    "abd": _abd,
+    "abd-ordered": _abd_ordered,
+    "scr": _scr,
+    "increment": _increment,
+    "increment-lock": _increment_lock,
+}
+
+#: The seven shipped packed-model configurations — the shapes
+#: ``tools/warm_cache.py`` pre-seeds the persistent XLA compile cache with
+#: so a fresh service's first request pays seconds, not minutes
+#: (VERDICT item 6: paxos warm <= 29 s).
+SHIPPED = (
+    "2pc:3",
+    "2pc:4",
+    "abd:2",
+    "abd-ordered:2",
+    "paxos:2,3",
+    "scr:3,1",
+    "increment-lock:3",
+)
+
+
+def parse(spec: str) -> Tuple[str, List[int]]:
+    """``"paxos:2,3"`` -> ``("paxos", [2, 3])``; raises ``ValueError`` on
+    an unknown family or malformed args (typed: admission control converts
+    nothing — a bad spec is a caller bug, not a capacity problem)."""
+    name, _, rest = spec.strip().partition(":")
+    if name not in FAMILIES:
+        raise ValueError(
+            f"unknown model spec {spec!r}; families: {sorted(FAMILIES)}"
+        )
+    try:
+        args = [int(a) for a in rest.split(",") if a.strip()] if rest else []
+    except ValueError:
+        raise ValueError(f"malformed spec args in {spec!r}") from None
+    return name, args
+
+
+def resolve(spec: str) -> Tuple[Any, Dict[str, int]]:
+    """Spec string -> ``(packed model, default spawn capacities)``."""
+    name, args = parse(spec)
+    return FAMILIES[name](args)

@@ -1,0 +1,206 @@
+"""One service job's process body: the unit of fault isolation.
+
+``CheckerService`` never touches the device from its own process — every
+device job runs THIS script in its own process group under
+``supervise.run_worker`` (heartbeat-polled, killable as a group), so a
+wedged tunnel dispatch or a runaway model takes down exactly one job and
+the service requeues it from its auto-checkpoint. The script is runnable
+both as ``python -m stateright_tpu.service.worker`` and by file path (the
+service invokes the latter so the child needs no import-path inheritance).
+
+Engines:
+
+- ``--engine xla`` (default): the single-chip device engine with per-job
+  in-loop auto-checkpointing (``--checkpoint``/``--every``/``--keep``) and
+  resume (``--resume``). The heartbeat rides in via ``STPU_HEARTBEAT``
+  (injected by ``run_worker``), the span trace via ``STPU_TRACE`` — both
+  per-job files under the service's run dir.
+- ``--engine host``: the host on-demand engine
+  (``stateright_tpu/checker/on_demand.py``) unblocked and driven in
+  ``--block-size`` blocks — the breaker's graceful-degradation target. No
+  tunnel, no wedge; always pinned to the CPU backend.
+
+Budgets: ``--max-states`` rides through ``target_state_count`` (the
+checker may exceed it by one block but never runs past it while more
+states exist); ``--max-seconds`` is a soft in-loop wall-clock check that
+exits with code 3 at the next quiescent point (the supervisor's hard
+timeout still backstops a worker that cannot reach one).
+
+Fault injection (the chaos suite's hooks, mirroring
+``tests/chaos_worker.py``): ``--chaos-die-at-depth N`` SIGKILLs the
+process at the first quiescent point at or past depth N;
+``--chaos-freeze-at-depth N`` rewrites the heartbeat to
+``phase="dispatch"`` and SIGSTOPs — the exact signature of a wedged
+tunnel. With ``--chaos-marker`` the sabotage trips exactly once (the
+requeued attempt runs clean); without it, every attempt trips — the
+repeat-wedge shape the breaker tests need.
+
+At completion the counts/discoveries/metrics land in ``--out`` (atomic
+write) for the service to parse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache (``STPU_COMPILE_CACHE`` names the dir;
+    the service and ``tools/warm_cache.py`` set it to the repo's
+    ``.jax_cache``): supersteps recompile identically across worker
+    processes, so a requeued job — or a fresh service whose cache
+    ``tools/warm_cache.py`` pre-seeded — pays seconds, not minutes."""
+    cache_dir = os.environ.get("STPU_COMPILE_CACHE")
+    if not cache_dir:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # pragma: no cover - cacheless jax builds
+        print(f"compile cache unavailable: {e}", file=sys.stderr)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--spec", required=True)  # service/registry.py grammar
+    p.add_argument("--engine", default="xla", choices=("xla", "host"))
+    p.add_argument("--platform", default="default")  # "default" | "cpu"
+    p.add_argument("--out", required=True)
+    p.add_argument("--checkpoint", default=None)  # auto-checkpoint base
+    p.add_argument("--resume", default=None)
+    p.add_argument("--every", default="1")
+    p.add_argument("--keep", type=int, default=3)
+    p.add_argument("--block-size", type=int, default=1500)
+    p.add_argument("--max-states", type=int, default=None)
+    p.add_argument("--max-seconds", type=float, default=None)
+    p.add_argument("--chaos-die-at-depth", type=int, default=None)
+    p.add_argument("--chaos-freeze-at-depth", type=int, default=None)
+    p.add_argument("--chaos-marker", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.engine == "host" or args.platform == "cpu":
+        # The env var alone cannot select CPU here (the container's
+        # sitecustomize pins the accelerator plugin at config level).
+        jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+
+    from stateright_tpu.service.registry import resolve
+
+    model, caps = resolve(args.spec)
+    builder = model.checker()
+    if args.max_states:
+        builder = builder.target_state_count(args.max_states)
+
+    t0 = time.monotonic()
+
+    def over_budget() -> bool:
+        return (
+            args.max_seconds is not None
+            and time.monotonic() - t0 > args.max_seconds
+        )
+
+    # Chaos arming: a marker file makes sabotage exactly-once (the requeued
+    # attempt runs clean); no marker means every attempt trips.
+    armed = (args.chaos_die_at_depth is not None
+             or args.chaos_freeze_at_depth is not None) and (
+        args.chaos_marker is None or not os.path.exists(args.chaos_marker)
+    )
+
+    def trip() -> None:
+        if args.chaos_marker is not None:
+            with open(args.chaos_marker, "w") as fh:
+                fh.write("tripped\n")
+
+    chaos_flags = (
+        args.chaos_die_at_depth is not None
+        or args.chaos_freeze_at_depth is not None
+    )
+    if args.engine == "xla":
+        kw = dict(caps)
+        if chaos_flags:
+            # Chaos runs force one level per dispatch: fine-grained
+            # quiescent points so the sabotage depth and the checkpoint
+            # cadence line up deterministically. Production jobs keep the
+            # engine's fused multi-level dispatch (the core perf
+            # mechanism: one tunnel RTT per up-to-32 levels); checkpoint
+            # cadence and budget checks then apply at dispatch-block
+            # granularity, as documented.
+            kw["levels_per_dispatch"] = 1
+        if args.checkpoint:
+            kw.update(
+                checkpoint_to=args.checkpoint,
+                checkpoint_every=args.every,
+                checkpoint_keep=args.keep,
+            )
+        if args.resume:
+            kw["checkpoint"] = args.resume
+        checker = builder.spawn_xla(**kw)
+        step = checker._run_block
+    else:
+        checker = builder.spawn_on_demand(block_size=1)
+        checker.run_to_completion()
+        step = lambda: checker._run_block(max(args.block_size, 1))  # noqa: E731
+
+    start_depth = checker._depth if args.engine == "xla" else 0
+
+    while not checker.is_done():
+        step()
+        if args.engine == "xla":
+            depth = checker._depth
+            if armed and args.chaos_die_at_depth is not None and (
+                depth >= args.chaos_die_at_depth
+            ):
+                trip()
+                os.kill(os.getpid(), signal.SIGKILL)
+            if armed and args.chaos_freeze_at_depth is not None and (
+                depth >= args.chaos_freeze_at_depth
+            ):
+                trip()
+                # A wedged tunnel's signature: the engine entered a device
+                # dispatch and never came back.
+                if checker._heartbeat is not None:
+                    checker._heartbeat.beat("dispatch", compile=False)
+                os.kill(os.getpid(), signal.SIGSTOP)
+        if over_budget():
+            return 3  # soft budget exit at a quiescent point
+
+    metrics = checker.metrics()
+    result = {
+        "spec": args.spec,
+        "engine": args.engine,
+        "platform": jax.default_backend(),
+        "degraded": args.engine == "host",
+        "generated": checker.state_count(),
+        "unique": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "discoveries": {
+            name: [repr(a) for a in path.into_actions()]
+            for name, path in sorted(checker.discoveries().items())
+        },
+        "resumed_from": args.resume,
+        "start_depth": start_depth,
+        "seconds": time.monotonic() - t0,
+        "metrics": metrics,
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(result, fh, default=str)
+    os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

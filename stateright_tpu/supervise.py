@@ -102,6 +102,35 @@ class WorkerResult:
     def ok(self) -> bool:
         return self.killed is None and self.rc == 0
 
+    @property
+    def wedged(self) -> bool:
+        """Whether the watchdog killed this attempt on a *liveness* verdict
+        (heartbeat stale mid-dispatch, or no beat within the startup
+        grace) — the wedged-tunnel signature — as opposed to the hard
+        wall-clock timeout (budget exhaustion, not a device fault). The
+        classification multi-job supervisors (``stateright_tpu/service``)
+        key their breaker and requeue policy on."""
+        return self.killed is not None and not self.killed.startswith(
+            "hard timeout"
+        )
+
+    @property
+    def crashed(self) -> bool:
+        """A natural exit by signal (rc < 0): the worker died mid-run —
+        SIGKILL from the OOM killer, a segfault — without any watchdog
+        verdict. Like a wedge, the remedy is resume-from-checkpoint; unlike
+        a wedge, it is not evidence against the device."""
+        return self.killed is None and self.rc is not None and self.rc < 0
+
+
+def backoff_delay(attempt: int, base_s: float) -> float:
+    """The retry ladder every supervisor here shares: exponential from
+    ``base_s``, where ``attempt`` counts retries from 1 (attempt 0 is the
+    first try and never waits)."""
+    if attempt < 1 or base_s <= 0:
+        return 0.0
+    return base_s * (2 ** (attempt - 1))
+
 
 def _kill_group(proc: subprocess.Popen, grace_s: float = 2.0) -> None:
     """Kill the worker's whole process group: TERM first (a healthy-but-slow
@@ -138,6 +167,7 @@ def run_worker(
     stdout_path: Optional[str] = None,
     poll_s: float = 5.0,
     log: Optional[Callable[[str], None]] = None,
+    on_spawn: Optional[Callable[[subprocess.Popen], None]] = None,
 ) -> WorkerResult:
     """ONE supervised attempt of ``argv``.
 
@@ -172,6 +202,11 @@ def run_worker(
             cwd=cwd,
             start_new_session=True,
         )
+        if on_spawn is not None:
+            # Hands the live Popen to multi-job supervisors (the service's
+            # close-with-kill path) — run_worker itself stays the only
+            # place that polls or reaps it.
+            on_spawn(proc)
         while True:
             try:
                 proc.wait(timeout=poll_s)
@@ -287,7 +322,7 @@ def supervise(
 
     for attempt in range(1 + retries):
         if attempt and backoff_s:
-            delay = backoff_s * (2 ** (attempt - 1))
+            delay = backoff_delay(attempt, backoff_s)
             _log(f"retry {attempt}/{retries} after {delay:.0f}s backoff")
             time.sleep(delay)
         if attempt_once(attempt, make_argv, **worker_kw):

@@ -2540,7 +2540,13 @@ class XlaChecker(Checker):
         Key set is stable across dedup structures (pinned by
         tests/test_obs.py); schema in docs/observability.md."""
         cap = self._table.capacity
+        job = (
+            {"job_id": self._service_job_id}
+            if self._service_job_id is not None
+            else {}
+        )
         return {
+            **job,
             "engine": "xla",
             "backend": self._jax.default_backend(),
             # -- configuration gauges ---------------------------------

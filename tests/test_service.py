@@ -1,0 +1,336 @@
+"""CheckerService chaos pins (ISSUE 9 acceptance).
+
+The multi-tenant pool must keep faults per-job and degrade instead of
+dying:
+
+- **Admission control**: beyond the queue/session caps, ``submit`` raises
+  the typed ``AdmissionError`` with a ``retry_after_s`` back-pressure hint
+  — never unbounded queueing; over-cap budgets are rejected without one.
+- **Kill-resume smoke** (<30s, rides in ``tools/smoke.sh``): a job
+  SIGKILLed mid-superstep requeues, resumes from its own auto-checkpoint
+  rotation, and converges to the exact pinned counts; its span trace
+  exports as a Chrome trace.
+- **Isolation pin**: with two CONCURRENT jobs, SIGSTOP-wedging one (the
+  wedged-tunnel signature: heartbeat frozen mid-"dispatch") draws a wedge
+  verdict that kills and quarantines only that job's process group; the
+  sibling's generated/unique/discovery counts are bit-identical to its
+  solo run, and the victim resumes from checkpoint to exact counts.
+- **Breaker pin**: K consecutive device wedge verdicts trip the breaker;
+  new jobs are served by the host on-demand engine with ``degraded: true``
+  and exact counts; a healthy device probe closes the breaker; the pool
+  gauges record the trip and the recovery.
+
+Supervision is the real library (``supervise.run_worker`` under
+``stateright_tpu/service/core.py``); the worker body is the real service
+worker (``stateright_tpu/service/worker.py``), CPU-pinned via the
+service's ``platform="cpu"`` knob.
+"""
+
+import json
+import os
+
+import pytest
+
+from stateright_tpu.service import (
+    AdmissionError,
+    CheckerService,
+    ServiceConfig,
+)
+
+#: Pinned full-coverage (generated, unique) counts (bench.py EXPECTED_*).
+PINNED = {
+    "2pc:3": (1_146, 288),
+    "2pc:4": (8_258, 1_568),
+    "scr:3,1": (6_778, 4_243),
+}
+
+
+def _config(tmp_path, **kw):
+    base = dict(
+        run_dir=str(tmp_path / "svc"),
+        platform="cpu",
+        default_max_seconds=420.0,
+        stall_s=8.0,
+        startup_grace_s=240.0,
+        poll_s=0.2,
+        backoff_s=0.1,
+        probe_auto=False,
+    )
+    base.update(kw)
+    return ServiceConfig(**base)
+
+
+_SOLO_CACHE = {}
+
+
+def _solo(spec):
+    """Uninterrupted in-process run of the same model at the worker's
+    engine settings — the ground truth a service job (and the isolation
+    pin's sibling) must reproduce bit-for-bit."""
+    if spec not in _SOLO_CACHE:
+        from stateright_tpu.service.registry import resolve
+
+        model, caps = resolve(spec)
+        c = model.checker().spawn_xla(**caps).join()
+        _SOLO_CACHE[spec] = {
+            "generated": c.state_count(),
+            "unique": c.unique_state_count(),
+            "max_depth": c.max_depth(),
+            "discoveries": {
+                name: [repr(a) for a in path.into_actions()]
+                for name, path in sorted(c.discoveries().items())
+            },
+        }
+    return _SOLO_CACHE[spec]
+
+
+def _assert_exact(result, spec):
+    ref = _solo(spec)
+    assert (result["generated"], result["unique"]) == PINNED[spec]
+    assert result["generated"] == ref["generated"]
+    assert result["unique"] == ref["unique"]
+    assert result["max_depth"] == ref["max_depth"]
+    assert result["discoveries"] == ref["discoveries"]
+
+
+# --- admission control -----------------------------------------------------
+
+
+def test_admission_rejection_at_caps(tmp_path):
+    svc = CheckerService(_config(tmp_path, max_inflight=1, max_queue=2))
+    # Admission accounting without workers: scheduling disarmed, so
+    # submitted jobs stay queued.
+    svc._ensure_scheduler = lambda: None
+    try:
+        with pytest.raises(ValueError, match="unknown model spec"):
+            svc.submit("nosuchmodel:9")
+        # An over-cap budget is rejected typed, with NO retry hint —
+        # retrying the same request cannot help.
+        with pytest.raises(AdmissionError) as exc:
+            svc.submit("2pc:3", max_seconds=10_000_000.0)
+        assert exc.value.retry_after_s is None
+        svc.submit("2pc:3")
+        svc.submit("2pc:3")
+        # Queue full: typed rejection carrying Retry-After, not unbounded
+        # queueing.
+        with pytest.raises(AdmissionError) as exc:
+            svc.submit("2pc:3")
+        assert exc.value.retry_after_s is not None
+        assert exc.value.retry_after_s > 0
+        assert "queue full" in exc.value.reason
+        g = svc.gauges()
+        assert g["queued"] == 2
+        assert g["rejected"] == 2
+        assert g["admitted"] == 2
+    finally:
+        svc.close()
+
+
+# --- kill-resume smoke (tools/smoke.sh; <30s) ------------------------------
+
+
+def test_smoke_service_kill_resume(tmp_path):
+    """The tier-0 service crash drill: one SIGKILL mid-superstep, one
+    supervised requeue resuming from the job's own checkpoint rotation,
+    exact pinned counts, downloadable Chrome trace."""
+    svc = CheckerService(_config(tmp_path))
+    try:
+        job = svc.submit(
+            "2pc:3",
+            chaos={"die_at_depth": 3, "marker": str(tmp_path / "m1")},
+        )
+        assert job.wait(timeout=240), job.snapshot()
+        assert job.status == "done", job.error
+        # First attempt died by SIGKILL (a crash, not a wedge — no breaker
+        # evidence); the requeued attempt resumed from the checkpoint.
+        assert job.attempts[0]["rc"] == -9
+        assert not job.attempts[0]["wedged"]
+        assert job.requeues == 1
+        assert job.resumed_from is not None
+        assert job.result["resumed_from"] == job.resumed_from
+        _assert_exact(job.result, "2pc:3")
+        assert job.result["metrics"]["checkpoints_written"] >= 1
+        # Per-job span trace downloads as Perfetto-loadable Chrome JSON.
+        chrome = svc.job_trace_chrome(job.id)
+        assert chrome is not None
+        with open(chrome) as fh:
+            events = json.load(fh)["traceEvents"]
+        assert any(e["name"] == "dispatch" for e in events)
+        g = svc.gauges()
+        assert g["jobs_done"] == 1 and g["crashes"] == 1
+        assert g["breaker"]["state"] == "closed"
+    finally:
+        svc.close()
+
+
+# --- isolation pin: two concurrent jobs, one SIGSTOP-wedged ----------------
+
+
+def test_sigstop_isolation_sibling_exact(tmp_path):
+    """SIGSTOP freezes the victim's heartbeat mid-"dispatch" (the wedged
+    tunnel signature). The service must kill+quarantine ONLY the victim's
+    process group and resume it from checkpoint, while the concurrently
+    running sibling converges bit-identically to its solo run."""
+    svc = CheckerService(_config(tmp_path, max_inflight=2))
+    try:
+        victim = svc.submit(
+            "2pc:4",
+            chaos={"freeze_at_depth": 4, "marker": str(tmp_path / "m2")},
+        )
+        sibling = svc.submit("scr:3,1")
+        assert svc.wait_all(timeout=800), svc.metrics()
+
+        # Sibling: untouched by the sibling-job wedge — counts, depth, and
+        # discovery paths bit-identical to a solo run.
+        assert sibling.status == "done", sibling.error
+        assert sibling.wedges == 0 and sibling.requeues == 0
+        assert len(sibling.attempts) == 1
+        _assert_exact(sibling.result, "scr:3,1")
+
+        # Victim: wedge verdict -> quarantine -> checkpoint resume ->
+        # exact counts.
+        assert victim.status == "done", victim.error
+        assert victim.wedges == 1
+        assert victim.attempts[0]["wedged"]
+        assert "stale" in victim.attempts[0]["killed"]
+        assert victim.resumed_from is not None
+        assert victim.result["start_depth"] >= 4  # resumed AT the wedge
+        _assert_exact(victim.result, "2pc:4")
+
+        g = svc.gauges()
+        assert g["wedge_verdicts"] == 1 and g["requeues"] >= 1
+        # One wedge < K: no trip, the pool never degraded.
+        assert g["breaker"]["state"] == "closed"
+        assert g["breaker_trips"] == 0
+    finally:
+        svc.close()
+
+
+# --- breaker: trip -> host fallback -> probe recovery ----------------------
+
+
+def test_breaker_trip_host_fallback_and_recovery(tmp_path):
+    import sys
+
+    svc = CheckerService(
+        _config(
+            tmp_path,
+            stall_s=6.0,
+            requeue_limit=1,
+            breaker_k=2,
+            probe_argv=[sys.executable, "-c", "pass"],
+        )
+    )
+    try:
+        # No chaos marker: the sabotage trips on EVERY attempt — the
+        # repeatedly-wedging-device shape. 2 attempts = 2 consecutive
+        # wedge verdicts = K.
+        wedger = svc.submit("2pc:3", chaos={"freeze_at_depth": 2})
+        assert wedger.wait(timeout=400), wedger.snapshot()
+        assert wedger.status == "failed"
+        assert wedger.wedges == 2
+        g = svc.gauges()
+        assert g["breaker"]["state"] == "open"
+        assert g["breaker"]["opened_unix_ts"] is not None
+        assert g["breaker_trips"] == 1
+        assert g["wedge_verdicts"] == 2
+        assert svc.degraded
+
+        # New jobs are served on the host on-demand engine: degraded,
+        # exact counts — the pool degrades instead of dying.
+        fallback = svc.submit("2pc:3")
+        assert fallback.wait(timeout=300), fallback.snapshot()
+        assert fallback.status == "done", fallback.error
+        assert fallback.engine == "host"
+        assert fallback.degraded
+        assert fallback.snapshot()["degraded"] is True
+        assert fallback.result["degraded"] is True
+        assert (
+            fallback.result["generated"], fallback.result["unique"]
+        ) == PINNED["2pc:3"]
+        # Host jobs have no tunnel, hence no heartbeat supervision and no
+        # device span trace to download.
+        assert svc.job_trace_chrome(fallback.id) is None
+
+        # A healthy device probe closes the breaker; the recovery is in
+        # the gauges.
+        assert svc.probe_device_now()
+        g = svc.gauges()
+        assert g["breaker"]["state"] == "closed"
+        assert g["breaker"]["opened_unix_ts"] is None
+        assert g["breaker_closes"] == 1
+        assert g["degraded_jobs"] == 1
+        assert not svc.degraded
+    finally:
+        svc.close()
+
+
+# --- the Explorer as one service client ------------------------------------
+
+
+def test_explorer_is_a_service_client(tmp_path):
+    from stateright_tpu.checker.explorer import make_app
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    svc = CheckerService(_config(tmp_path, max_sessions=1))
+    try:
+        app, checker = make_app(TwoPhaseSys(3).checker(), service=svc)
+        status = app.status()
+        # Pre-service keys unchanged for existing consumers...
+        for key in (
+            "done", "model", "state_count", "unique_state_count",
+            "max_depth", "properties", "recent_path", "metrics",
+            "last_checkpoint",
+        ):
+            assert key in status
+        # ...plus the per-job pool fields.
+        assert status["job"] is not None
+        assert status["degraded"] is False
+        assert status["pool"]["interactive"] == 1
+        assert status["pool"]["breaker"]["state"] == "closed"
+        assert status["metrics"]["job_id"] == status["job"]
+        code, pool = app.pool()
+        assert code == 200
+        assert status["job"] in pool["jobs"]
+        assert pool["jobs"][status["job"]]["kind"] == "interactive"
+
+        # Interactive admission: the session cap rejects typed, like any
+        # other tenant.
+        with pytest.raises(AdmissionError, match="sessions full"):
+            make_app(TwoPhaseSys(3).checker(), service=svc)
+        job = svc.job(status["job"])
+        svc.release_interactive(job)
+        app2, _ = make_app(TwoPhaseSys(3).checker(), service=svc)
+        assert app2.status()["pool"]["interactive"] == 1
+    finally:
+        svc.close()
+
+
+def test_explorer_degrades_while_breaker_open(tmp_path):
+    """With the breaker open the service does not hand the device to
+    anyone: an auto/xla Explorer session is served by the host on-demand
+    engine with ``degraded: true`` in /.status."""
+    from stateright_tpu.checker.explorer import make_app
+    from stateright_tpu.checker.on_demand import OnDemandChecker
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    svc = CheckerService(_config(tmp_path))
+    try:
+        with svc._cond:
+            svc._breaker = "open"
+        app, checker = make_app(
+            PackedTwoPhaseSys(3).checker(),
+            service=svc,
+            frontier_capacity=1 << 8,
+            table_capacity=1 << 10,
+        )
+        assert isinstance(checker, OnDemandChecker)
+        status = app.status()
+        assert status["degraded"] is True
+        assert status["pool"]["breaker"]["state"] == "open"
+        # The degraded session still serves the model: init states expand
+        # on the host engine.
+        code, inits = app.states("/")
+        assert code == 200 and len(inits) == 1
+    finally:
+        svc.close()
