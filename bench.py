@@ -111,6 +111,38 @@ def _audit(checker) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _lint_ok() -> bool | None:
+    """The stpu-lint verdict from runs/lint.json (written by
+    tools/smoke.sh's lint stage / tools/stpu_lint.py --json-out), as
+    tri-state provenance: True/False, or None when no artifact exists,
+    it does not parse, it records a PARTIAL (--only/--rules filtered)
+    run, or it is STALE — older than the newest package source file or
+    the waiver file, i.e. a verdict about some other tree. An absent,
+    partial, or stale lint run is not a pass."""
+    try:
+        path = os.path.join(RUNS, "lint.json")
+        lint_mtime = os.path.getmtime(path)
+        inputs = [os.path.join(REPO, ".stpu-lint-waivers.toml")]
+        pkg = os.path.join(REPO, "stateright_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            inputs += [
+                os.path.join(dirpath, fn)
+                for fn in filenames
+                if fn.endswith(".py")
+            ]
+        for p in inputs:
+            if os.path.exists(p) and os.path.getmtime(p) > lint_mtime:
+                return None
+        with open(path) as fh:
+            report = json.load(fh)
+            if report.get("partial"):
+                return None
+            return bool(report["ok"])
+    except Exception:
+        return None
+
+
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
     os.makedirs(RUNS, exist_ok=True)
@@ -581,6 +613,14 @@ def _worker(platform: str) -> None:
                         "states_at_resume": states0,
                         "levels_replayed": 0,
                     },
+                    # stpu-lint provenance (docs/static-analysis.md):
+                    # the latest runs/lint.json verdict — True/False, or
+                    # None when no lint artifact exists (run
+                    # tools/smoke.sh or tools/stpu_lint.py --json-out
+                    # runs/lint.json). A banked bench row should carry
+                    # lint_ok: true — numbers measured on a tree that
+                    # violates a pinned-miscompile rule are suspect.
+                    "lint_ok": _lint_ok(),
                     "generated_states": states,
                     "unique_states": checker.unique_state_count(),
                     "max_depth": checker.max_depth(),

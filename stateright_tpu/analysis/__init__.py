@@ -1,0 +1,18 @@
+"""stpu-lint: static jaxpr/HLO + AST analysis enforcing the pinned
+backend-miscompile rules (docs/static-analysis.md).
+
+CLI: ``python -m stateright_tpu.analysis`` (wrapped by
+``tools/stpu_lint.py``); library entry: :func:`run_lint`.
+"""
+
+from .rules import (  # noqa: F401
+    MAX_SAFE_SORT_OPERANDS,
+    RULES,
+    Finding,
+    Rule,
+    Waiver,
+    WaiverError,
+    apply_waivers,
+    load_waivers,
+)
+from .cli import DEFAULT_WAIVERS, main, run_lint  # noqa: F401

@@ -1,0 +1,214 @@
+"""stpu-lint orchestration and CLI (``python -m stateright_tpu.analysis``).
+
+Runs entirely on the CPU backend with no device access and no program
+execution: the jaxpr pass traces the registered surfaces
+(``surfaces.py``), the AST pass parses the package source
+(``astlint.py``), and findings are filtered through the waiver file
+(``rules.py``). Exit codes for CI:
+
+- 0 — clean (waived findings allowed; they are reported, not counted),
+- 1 — unwaived findings,
+- 2 — infrastructure error (a surface failed to trace, malformed waiver
+  file): the tree was NOT verified.
+
+``--json`` / ``--json-out`` emit the machine-readable report
+(``tools/smoke.sh`` writes ``runs/lint.json``; ``bench.py`` records its
+verdict as ``lint_ok`` provenance in ``bench_detail.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .rules import RULES, Finding, WaiverError, apply_waivers, load_waivers
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_WAIVERS = os.path.join(_REPO, ".stpu-lint-waivers.toml")
+
+
+def run_lint(
+    *,
+    trace: bool = True,
+    ast_pass: bool = True,
+    full: bool = False,
+    only: Optional[List[str]] = None,
+    rules: Optional[List[str]] = None,
+    waivers_path: Optional[str] = DEFAULT_WAIVERS,
+) -> dict:
+    """The whole lint as a dict report (the CLI's JSON schema; tests and
+    bench consume this directly)."""
+    t0 = time.monotonic()
+    waivers = load_waivers(waivers_path)
+
+    findings: List[Finding] = []
+    surfaces = []
+    errors: List[str] = []
+    # A --rules filter naming only AST rules never needs the (much
+    # slower) jaxpr sweep; same for jaxpr-only filters and the AST pass.
+    if rules:
+        unknown = sorted(set(rules) - set(RULES))
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown}; known: {sorted(RULES)}"
+            )
+        kinds = {RULES[r].kind for r in rules}
+        trace = trace and "jaxpr" in kinds
+        ast_pass = ast_pass and "ast" in kinds
+    if trace:
+        from .surfaces import run_sweep
+
+        for rep in run_sweep(full=full, only=only):
+            surfaces.append(
+                {
+                    "name": rep.name,
+                    "seconds": rep.seconds,
+                    "findings": len(rep.findings),
+                    "error": rep.error,
+                }
+            )
+            findings.extend(rep.findings)
+            if rep.error:
+                errors.append(f"{rep.name}: {rep.error}")
+    if ast_pass:
+        from .astlint import run_ast_pass
+
+        findings.extend(run_ast_pass())
+
+    if rules:
+        keep = set(rules)
+        findings = [f for f in findings if f.rule in keep]
+
+    active, waived, unused = apply_waivers(findings, waivers)
+    # A filtered run is PARTIAL: its verdict covers only what it swept.
+    # Stale-waiver detection is suppressed (a live waiver's findings may
+    # simply never have fired), and the flag rides in the report so
+    # provenance consumers (bench.py's lint_ok) never mistake a
+    # --only/--rules iteration artifact for a full-tree verdict.
+    partial = bool(rules or only or not (trace and ast_pass))
+    if partial:
+        unused = []
+    return {
+        "ok": not active and not errors,
+        "partial": partial,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "surfaces": surfaces,
+        "findings": [f.to_json() for f in active],
+        "waived": [f.to_json() for f in waived],
+        "unused_waivers": [
+            {"rule": w.rule, "surface": w.surface, "file": w.file, "reason": w.reason}
+            for w in unused
+        ],
+        "errors": errors,
+        "rules": {r.id: r.title for r in RULES.values()},
+    }
+
+
+def _print_human(report: dict) -> None:
+    for f in report["findings"] + report["waived"]:
+        print(Finding(**{k: f[k] for k in (
+            "rule", "surface", "file", "line", "message", "excerpt",
+            "waived", "waiver_reason")}).format())
+    for e in report["errors"]:
+        print(f"ERROR: {e}")
+    for w in report["unused_waivers"]:
+        print(
+            f"stale waiver (matched nothing): {w['rule']} "
+            f"surface={w['surface']!r} file={w['file']!r} — prune it"
+        )
+    n_surf = len(report["surfaces"])
+    print(
+        f"stpu-lint: {n_surf} surfaces, "
+        f"{len(report['findings'])} finding(s), "
+        f"{len(report['waived'])} waived, "
+        f"{len(report['errors'])} error(s) "
+        f"in {report['elapsed_s']}s -> "
+        + ("OK" if report["ok"] else "FAIL")
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m stateright_tpu.analysis",
+        description=(
+            "stpu-lint: mechanically enforce the pinned backend-"
+            "miscompile rules over every shipped kernel surface "
+            "(docs/static-analysis.md)"
+        ),
+    )
+    p.add_argument("--json", action="store_true", help="JSON report on stdout")
+    p.add_argument("--json-out", metavar="PATH", help="also write the JSON report here")
+    p.add_argument(
+        "--rules",
+        metavar="IDS",
+        help="comma-separated rule filter, e.g. STPU001,STPU003",
+    )
+    p.add_argument(
+        "--only",
+        metavar="SUBSTR",
+        action="append",
+        help="only surfaces whose name contains SUBSTR (repeatable)",
+    )
+    p.add_argument(
+        "--waivers",
+        default=DEFAULT_WAIVERS,
+        help="waiver file (default: .stpu-lint-waivers.toml at repo root)",
+    )
+    p.add_argument(
+        "--no-trace", action="store_true", help="skip the jaxpr surface sweep"
+    )
+    p.add_argument("--no-ast", action="store_true", help="skip the AST pass")
+    p.add_argument(
+        "--full",
+        action="store_true",
+        help="full config matrix for every spec (slower; default sweeps "
+        "the matrix on one narrow + one wide model)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule registry and exit"
+    )
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id} [{r.kind}] {r.title}\n    {r.history}\n")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [s.strip() for s in args.rules.split(",") if s.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {unknown}; known: {sorted(RULES)}", file=sys.stderr)
+            return 2
+
+    try:
+        report = run_lint(
+            trace=not args.no_trace,
+            ast_pass=not args.no_ast,
+            full=args.full,
+            only=args.only,
+            rules=rules,
+            waivers_path=args.waivers,
+        )
+    except WaiverError as e:
+        print(f"waiver file error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json_out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True)
+        with open(args.json_out, "w") as fh:
+            json.dump(report, fh, indent=1)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1)
+        print()
+    else:
+        _print_human(report)
+
+    if report["errors"]:
+        return 2
+    return 0 if report["ok"] else 1

@@ -1,0 +1,280 @@
+"""stpu-lint rule registry, findings, and the waiver file.
+
+Each rule ID names ONE pinned backend pathology (docs/backend_pathologies.md,
+docs/static-analysis.md) that was root-caused on real hardware and is now
+enforced mechanically instead of by tribal knowledge:
+
+- STPU001-005 are jaxpr-level invariants checked against the lowered
+  representation of every registered kernel surface
+  (``stateright_tpu/analysis/surfaces.py``);
+- STPU101-103 are AST-level project rules over the package source
+  (``stateright_tpu/analysis/astlint.py``).
+
+Findings that are KNOWN-correct exceptions are waived in
+``.stpu-lint-waivers.toml`` at the repo root — every waiver carries a
+one-line justification and matches findings by rule + glob patterns over
+the surface name and file. An unmatched waiver is itself reported (a
+stale waiver hides nothing but rots the record).
+
+The waiver file is TOML restricted to ``[[waiver]]`` array-of-tables with
+string values (this container runs Python 3.10 — no stdlib ``tomllib`` —
+so :func:`_parse_waivers_toml` is a minimal parser for exactly that
+subset, loud on anything else).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    #: Which pass owns it: "jaxpr" or "ast".
+    kind: str
+    #: The measured failure this rule pins (the "why", shown by
+    #: ``--list-rules`` and docs/static-analysis.md).
+    history: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            "STPU001",
+            "no data-dependent scatter inside a vmapped model kernel",
+            "jaxpr",
+            "XLA:TPU silently DROPS data-dependent one-element scatters "
+            "inside vmapped model kernels at batch >= 4096 (round-3/5 "
+            "on-chip paxos count drift; bisected in tools/paxos_diag.py). "
+            "Traced-index packed-field writes must lower one-hot via "
+            "packing._word_update. Static-index scatters are exempt: XLA "
+            "folds them and the pinned drift never reproduced there.",
+        ),
+        Rule(
+            "STPU002",
+            "no transpose fused into a vmapped kernel on the CPU path",
+            "jaxpr",
+            "XLA:CPU (jax 0.9.0 lineage) MIScompiles a transpose fused "
+            "into a vmapped kernel: a scalar-cond jnp.where inside the "
+            "kernel returns the wrong branch at batch >= 64, eager and "
+            "jit disagree (_build_superstep_planes docstring). "
+            "Rows-in/transpose-out is the safe fusion direction, so a "
+            "kernel-surface jaxpr must not hand its outputs straight out "
+            "of a transpose (the vmap out_axes != 0 shape).",
+        ),
+        Rule(
+            "STPU003",
+            "lax.sort operand count within the chip-proven width",
+            "jaxpr",
+            "A wide-W sort-mode grid compaction is a W+3-operand lax.sort "
+            "whose XLA:TPU *compile* stalls for tens of minutes (round-5, "
+            "paxos W=25: two bench workers lost at 28 operands), while "
+            "narrow-W sort-family lowerings are chip-proven. The engine's "
+            "auto policy caps sort-family compaction at state_words <= 8 "
+            "(<= 12 sort operands); any surface carrying a wider sort "
+            "re-introduces the stall shape.",
+        ),
+        Rule(
+            "STPU004",
+            "deltaset flush never under a lax.cond branch",
+            "jaxpr",
+            "A lax.cond carrying the main-capacity flush sort reproducibly "
+            "FAULTS the XLA:TPU runtime ('TPU worker crashed - kernel "
+            "fault', observed at 2^22 and 2^27 main tiers, round 5). The "
+            "flush is the host-invoked maintain program through the "
+            "overflow protocol; no cond/switch branch in a delta-dedup "
+            "surface may contain a table-scale sort.",
+        ),
+        Rule(
+            "STPU005",
+            "Mosaic TC kernel rules + mandatory TPU lowering pre-flight",
+            "jaxpr",
+            "Mosaic TC kernels have no cumsum lowering, no u32<->f32 "
+            "casts, and reject dynamic-offset vector stores (r5e first "
+            "silicon; registry #6). Mosaic lowering runs host-side, so "
+            "jit(f).trace(...).lower(lowering_platforms=('tpu',)) on CPU "
+            "pre-flights every pallas kernel without a tunnel window - "
+            "the pre-flight is mandatory for every kernel in ops/, and "
+            "this rule also scans kernel jaxprs for the three shapes "
+            "the r5e rework banned.",
+        ),
+        Rule(
+            "STPU101",
+            "traced-index packed-field writes go through packing",
+            "ast",
+            "Direct .at[...].set/.add writes in model kernel code are the "
+            "exact shape STPU001 exists for, caught at the source level "
+            "before anything is traced: route them through "
+            "packing.Layout.set / packing._word_update, which owns the "
+            "backend-split (scatter on CPU, one-hot on accelerators).",
+        ),
+        Rule(
+            "STPU102",
+            "no bare jax.devices()/backend bring-up outside backend.py",
+            "ast",
+            "The axon TPU tunnel WEDGES instead of failing: jax.devices() "
+            "blocks forever when the tunnel is down (CLAUDE.md gotcha #1). "
+            "Backend bring-up belongs behind backend.ensure_live_backend / "
+            "backend.guarded_main (probe subprocess + supervised re-exec); "
+            "a bare call anywhere else re-opens the round-4 hang window.",
+        ),
+        Rule(
+            "STPU103",
+            "checkpoint/heartbeat files written atomically",
+            "ast",
+            "Checkpoints and heartbeats are read by watchdogs and resumed "
+            "from after SIGKILL; a plain open(path, 'w') can be observed "
+            "torn. checkpoint.py and obs/ own the tmp + os.replace "
+            "pattern (payload sha256, rotation); writes to *checkpoint* / "
+            "*heartbeat* paths outside them must go through those codecs.",
+        ),
+    )
+}
+
+#: STPU003's chip-proven ceiling: the widest sort-family lowering the
+#: round-5 A/Bs measured healthy is the W=8 sort-compaction class
+#: (key + W state planes + 3 payload lanes = 12 operands); the pinned
+#: compile stall was at 28 (W=25). Conservative midpoint: anything
+#: above 16 operands is the stall shape.
+MAX_SAFE_SORT_OPERANDS = 16
+
+
+@dataclass
+class Finding:
+    rule: str
+    #: Which registered surface (jaxpr pass) or file (AST pass) tripped.
+    surface: str
+    #: Repo-relative path and 1-based line of the best source anchor.
+    file: str
+    line: int
+    message: str
+    #: The lowered-op excerpt (jaxpr eqn) or source line that matched.
+    excerpt: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "surface": self.surface,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "excerpt": self.excerpt,
+            "waived": self.waived,
+            "waiver_reason": self.waiver_reason,
+        }
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.file else "<no-source>"
+        tag = " [waived: %s]" % self.waiver_reason if self.waived else ""
+        out = f"{loc}: {self.rule} [{self.surface}] {self.message}{tag}"
+        if self.excerpt:
+            out += f"\n    | {self.excerpt}"
+        return out
+
+
+@dataclass
+class Waiver:
+    rule: str
+    reason: str
+    surface: str = "*"
+    file: str = "*"
+    used: int = field(default=0, compare=False)
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.rule == self.rule
+            and fnmatch.fnmatchcase(f.surface, self.surface)
+            and fnmatch.fnmatchcase(f.file, self.file)
+        )
+
+
+class WaiverError(ValueError):
+    """Malformed waiver file — typed, so the CLI exits 2 (internal/config
+    error), never silently ignoring a waiver that was meant to apply."""
+
+
+def _parse_waivers_toml(text: str, path: str) -> List[Waiver]:
+    """Minimal TOML subset parser: ``[[waiver]]`` tables of
+    ``key = "string"`` pairs; comments and blank lines. Loud on anything
+    else (Python 3.10 has no tomllib; this file format is ours)."""
+    waivers: List[Waiver] = []
+    current: Optional[dict] = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[waiver]]":
+            if current is not None:
+                waivers.append(_finish_waiver(current, path))
+            current = {"_line": lineno}
+            continue
+        if "=" in line and current is not None:
+            key, _, val = line.partition("=")
+            key = key.strip()
+            val = val.strip()
+            if key in ("rule", "reason", "surface", "file") and (
+                len(val) >= 2 and val[0] == '"' and val[-1] == '"'
+            ):
+                current[key] = val[1:-1]
+                continue
+        raise WaiverError(
+            f"{path}:{lineno}: unsupported waiver syntax {raw!r} "
+            "(only [[waiver]] tables with rule/reason/surface/file "
+            'string keys, e.g. rule = "STPU001")'
+        )
+    if current is not None:
+        waivers.append(_finish_waiver(current, path))
+    return waivers
+
+
+def _finish_waiver(d: dict, path: str) -> Waiver:
+    line = d.pop("_line")
+    if "rule" not in d or "reason" not in d:
+        raise WaiverError(
+            f"{path}:{line}: every [[waiver]] needs 'rule' and a "
+            "one-line 'reason' justifying it"
+        )
+    if d["rule"] not in RULES:
+        raise WaiverError(
+            f"{path}:{line}: unknown rule {d['rule']!r}; "
+            f"known: {sorted(RULES)}"
+        )
+    if not d["reason"].strip():
+        raise WaiverError(f"{path}:{line}: empty waiver reason")
+    return Waiver(**d)
+
+
+def load_waivers(path: Optional[str]) -> List[Waiver]:
+    """Waivers from ``path`` (missing file = no waivers)."""
+    if path is None or not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        return _parse_waivers_toml(fh.read(), path)
+
+
+def apply_waivers(
+    findings: List[Finding], waivers: List[Waiver]
+) -> Tuple[List[Finding], List[Finding], List[Waiver]]:
+    """Split findings into (active, waived); also return UNUSED waivers
+    (stale entries worth pruning — reported, not fatal)."""
+    active: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        for w in waivers:
+            if w.matches(f):
+                f.waived = True
+                f.waiver_reason = w.reason
+                w.used += 1
+                waived.append(f)
+                break
+        else:
+            active.append(f)
+    unused = [w for w in waivers if w.used == 0]
+    return active, waived, unused

@@ -1,0 +1,456 @@
+"""The registered kernel surfaces stpu-lint sweeps.
+
+A *surface* is one traceable device program the repo ships: a packed
+model's vmapped transition/property kernels, an engine superstep at a
+concrete dedup x compaction configuration, a fused multi-level dispatch,
+one of the standalone ops programs (deltaset ``maintain``, hashset
+``insert``), or a Pallas kernel. Each surface traces to a ``ClosedJaxpr``
+on the CPU backend — no device, no execution, no XLA compile — and
+declares which rule scans apply:
+
+- kernel surfaces take STPU001/STPU002 (the two pinned vmapped-kernel
+  miscompiles) — these must be checked on the STANDALONE vmapped kernel,
+  because engine-level programs legitimately contain scatters (the rows
+  engine's cumsum+scatter compaction on CPU) that are not the pinned
+  shape;
+- engine surfaces take STPU003 (sort width, W-dependent) and — for
+  delta-dedup programs — STPU004 (no flush under cond);
+- Pallas surfaces take the STPU005 static scans plus the mandatory TPU
+  lowering pre-flight (Mosaic lowering runs host-side, so
+  ``jit(f).trace(...).lower(lowering_platforms=("tpu",))`` pre-flights a
+  kernel from this CPU-only box; registry #6).
+
+Kernel tracing forces ``packing.ONE_HOT_WRITES = True`` — the
+ACCELERATOR lowering of traced-index field writes — exactly like the old
+``tests/test_packing.py`` HLO pin this sweep generalizes: the CPU
+backend keeps its (correct, O(1)) scatter writes, and linting that path
+would only measure the backend split, not the chip invariant.
+
+The default sweep is sized for the <60 s 1-core CI budget: every shipped
+spec's kernel surfaces and policy-resolved sorted-engine superstep, plus
+the full config matrix (hash rows engine, delta, bsearch/pallas
+compaction, fused programs) on one narrow (2pc:3, W=2) and one wide
+(paxos:2,3, W=25) model — engine code is shared across models, so the
+config matrix varies by W class, not by model count. ``--full`` sweeps
+the whole matrix for every spec.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from .jaxpr_lint import (
+    cond_flush_sorts,
+    mosaic_kernel_rules,
+    output_transposes,
+    taint_scatters,
+    wide_sorts,
+)
+from .rules import Finding
+
+#: Batch the kernel surfaces trace at. The pinned scatter drop needs
+#: batch >= 4096 at RUNTIME; the jaxpr is structurally batch-independent,
+#: but tracing at the dangerous scale keeps the pin honest.
+KERNEL_BATCH = 4096
+
+#: Engine-surface trace shapes: small (trace cost only — shapes never
+#: run), but divisible by the pallas kernel block so the pallas
+#: compaction path engages instead of falling back to the sort.
+F_CAP = 1024
+CAND_CAP = 1024
+TABLE_CAP = 1 << 13
+#: Delta-dedup surfaces trace with a bigger main tier: STPU004's
+#: "table-scale" threshold is the main capacity C, and the legitimate
+#: in-program sorts (the [Dc + batch] delta merge, the A*F_CAP grid
+#: compaction inside a fused ladder branch) must sit clearly BELOW it at
+#: the trace shapes or they false-positive. C = 2^15 clears the largest
+#: legitimate in-cond sort the default sweep traces (2pc fused: 17 *
+#: F_CAP = 17408 grid lanes) while the flush shape ([C + Dc] lanes)
+#: stays >= C. A fused-delta surface for a model with max_actions *
+#: F_CAP >= C would need this raised.
+TABLE_CAP_DELTA = 1 << 15
+
+#: The two models the full config matrix runs on by default: one narrow
+#: and one wide state (the sort-width classes the compaction policy
+#: splits on).
+MATRIX_SPECS = ("2pc:3", "paxos:2,3")
+
+
+@dataclass
+class SurfaceReport:
+    name: str
+    findings: List[Finding] = field(default_factory=list)
+    seconds: float = 0.0
+    #: Non-empty when the surface failed to TRACE (an infrastructure
+    #: failure, not a rule finding — the CLI exits 2 on these: a surface
+    #: that cannot be checked is not a pass).
+    error: str = ""
+
+
+def pin_cpu() -> None:
+    """The analyzer never touches a device: pin the CPU backend before
+    any jax backend use (env alone cannot override the sitecustomize's
+    config-level accelerator pin — CLAUDE.md gotcha #2). Guarded: on a
+    jax lineage where a post-init update raises, an already-CPU process
+    proceeds; anything else is a real configuration error."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:  # pragma: no cover - backend already initialized
+        if jax.default_backend() != "cpu":
+            raise
+
+
+def _jnp():
+    import jax
+
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _trace(fn, *args):
+    import jax
+
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _step3(model, jnp):
+    def step3(words):
+        out = model.packed_step(words)
+        if len(out) == 3:
+            return out
+        nxt, valid = out
+        return nxt, valid, jnp.zeros_like(valid)
+
+    return step3
+
+
+# --- surface builders -------------------------------------------------------
+
+
+def _kernel_surfaces(spec: str, model) -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    jax, jnp = _jnp()
+    W = model.state_words
+    rows = _sds((KERNEL_BATCH, W), jnp.uint32)
+
+    def scan(name, fn):
+        def run():
+            jx = _trace(jax.vmap(fn), rows)
+            return (
+                taint_scatters(jx, name)
+                + output_transposes(jx, name)
+                + wide_sorts(jx, name)
+            )
+
+        return run
+
+    out = [
+        (f"kernel:{spec}:packed_step", scan(f"kernel:{spec}:packed_step", model.packed_step)),
+        (
+            f"kernel:{spec}:packed_properties",
+            scan(f"kernel:{spec}:packed_properties", model.packed_properties),
+        ),
+    ]
+    if hasattr(model, "packed_representative"):
+        out.append(
+            (
+                f"kernel:{spec}:packed_representative",
+                scan(
+                    f"kernel:{spec}:packed_representative",
+                    model.packed_representative,
+                ),
+            )
+        )
+
+    # The STPU_EXPAND_LAYOUT=planes A/B variant: vmap emits [A, W, F]
+    # directly (out_axes=2) — the transpose-fused-into-vmap shape. Kept
+    # in the sweep so STPU002 proves it still exists ONLY behind the
+    # accelerator-gated knob (the finding is waived with that
+    # justification; losing the waiver match means the shape moved).
+    name = f"kernel:{spec}:packed_step:planes-expand"
+
+    def run_planes():
+        step3 = _step3(model, jnp)
+        jx = _trace(jax.vmap(step3, out_axes=(2, 0, 0)), rows)
+        return taint_scatters(jx, name) + output_transposes(jx, name)
+
+    out.append((name, run_planes))
+    return out
+
+
+def _superstep_args(checker, model, f_cap: int):
+    _, jnp = _jnp()
+    P = len(checker._prop_names)
+    return (
+        _sds((f_cap, model.state_words), jnp.uint32),
+        _sds((f_cap,), jnp.uint32),
+        _sds((), jnp.int32),
+        checker._table,
+        _sds((P,), jnp.bool_),
+        _sds((P, 2), jnp.uint32),
+    )
+
+
+def _spawn(spec: str, dedup: str, compaction: str = "auto"):
+    from ..service.registry import resolve
+
+    model, _ = resolve(spec)
+    checker = model.checker().spawn_xla(
+        dedup=dedup,
+        compaction=compaction,
+        frontier_capacity=F_CAP,
+        table_capacity=TABLE_CAP_DELTA if dedup == "delta" else TABLE_CAP,
+    )
+    return model, checker
+
+
+def _flush_lanes(checker) -> Optional[int]:
+    """STPU004's table-scale threshold: the delta structure's main
+    capacity (the flush sort is [C + Dc] lanes, every in-program delta
+    sort is [Dc + batch] — strictly below C at the trace shapes)."""
+    if checker._dedup != "delta":
+        return None
+    return checker._table.main_capacity
+
+
+def _engine_surface(spec: str, dedup: str, compaction: str):
+    tag = dedup if compaction in ("auto",) else f"{dedup}-{compaction}"
+    name = f"engine:{spec}:superstep:{tag}"
+
+    def run():
+        model, checker = _spawn(spec, dedup, compaction)
+        step = checker._build_superstep(F_CAP, CAND_CAP)
+        jx = _trace(step, *_superstep_args(checker, model, F_CAP))
+        return (
+            wide_sorts(jx, name)
+            + cond_flush_sorts(jx, name, _flush_lanes(checker))
+            + mosaic_kernel_rules(jx, name)
+        )
+
+    return name, run
+
+
+def _fused_surface(spec: str, dedup: str):
+    name = f"engine:{spec}:fused:{dedup}"
+
+    def run():
+        jax, jnp = _jnp()
+        model, checker = _spawn(spec, dedup)
+        rungs = tuple(checker._cand_rungs(F_CAP))
+        fused = checker._build_fused(F_CAP, rungs)
+        P = len(checker._prop_names)
+        scalars = _sds((), jnp.int32)
+        args = _superstep_args(checker, model, F_CAP) + (
+            scalars,
+            scalars,
+            _sds((P,), jnp.bool_),
+            scalars,
+            scalars,
+            scalars,
+        )
+        jx = _trace(fused, *args)
+        return (
+            wide_sorts(jx, name)
+            + cond_flush_sorts(jx, name, _flush_lanes(checker))
+            + mosaic_kernel_rules(jx, name)
+        )
+
+    return name, run
+
+
+def _accel_policy_compaction(model) -> str:
+    """The compaction the accelerator auto-policy resolves for this
+    model's width (the lint runs on CPU, so 'auto' would resolve the
+    CPU answer — the sweep must check the path the CHIP runs). Shared
+    with the engine: one definition, no drift."""
+    from ..xla import accel_auto_compaction
+
+    return accel_auto_compaction(model.state_words)
+
+
+def _ops_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    jax, jnp = _jnp()
+
+    def maintain_run():
+        from ..ops import deltaset
+
+        ds = deltaset.make(TABLE_CAP, jnp)
+        jx = _trace(deltaset.maintain, ds)
+        name = "ops:deltaset-maintain"
+        # The maintain sort IS table-scale — the point is that it is a
+        # standalone host-invoked program, so it must carry no cond at
+        # all around that sort. flush_lanes = main capacity applies.
+        return wide_sorts(jx, name) + cond_flush_sorts(
+            jx, name, ds.main_capacity
+        )
+
+    def hashset_run():
+        from ..ops import hashset
+
+        name = "ops:hashset-insert"
+        table = hashset.make(TABLE_CAP, jnp)
+        n = 512
+        u32 = _sds((n,), jnp.uint32)
+        active = _sds((n,), jnp.bool_)
+
+        def insert(table, hi, lo, vh, vl, act):
+            return hashset.insert(table, hi, lo, vh, vl, act, max_probes=32)
+
+        jx = _trace(insert, table, u32, u32, u32, u32, active)
+        # The open-addressing insert scatters at probed (data-dependent)
+        # slots by DESIGN — correct there (not a vmapped model kernel;
+        # four rounds of exact counts) and waived in
+        # .stpu-lint-waivers.toml. The finding must keep firing so the
+        # waiver stays honest.
+        return taint_scatters(jx, name)
+
+    return [
+        ("ops:deltaset-maintain", maintain_run),
+        ("ops:hashset-insert", hashset_run),
+    ]
+
+
+def _pallas_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    jax, jnp = _jnp()
+
+    def preflight(name, fn, *args) -> List[Finding]:
+        """Registry #6: the TPU lowering pre-flight, as a lint check.
+        Mosaic lowering runs host-side; a kernel that cannot lower for
+        the TPU target is a finding, not a crash."""
+        try:
+            jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+        except Exception as e:
+            first = str(e).strip().splitlines()
+            return [
+                Finding(
+                    rule="STPU005",
+                    surface=name,
+                    file="",
+                    line=0,
+                    message=(
+                        "TPU lowering pre-flight failed "
+                        f"({type(e).__name__}) — every ops/ pallas "
+                        "kernel must lower for the TPU target from CPU "
+                        "(registry #6)"
+                    ),
+                    excerpt=first[0] if first else type(e).__name__,
+                )
+            ]
+        return []
+
+    def compact_run():
+        from ..ops.pallas_compact import compact_pallas_staged
+
+        name = "pallas:compact"
+        M, cap, P = 2048, 2048, 4
+        mask = _sds((M,), jnp.bool_)
+        lanes = [_sds((M,), jnp.uint32) for _ in range(P)]
+
+        def fn(m, *ls):
+            return compact_pallas_staged(m, list(ls), cap, block=512)
+
+        jx = _trace(fn, mask, *lanes)
+        return mosaic_kernel_rules(jx, name) + preflight(name, fn, mask, *lanes)
+
+    def merge_run():
+        from ..ops.pallas_merge import merge_insert
+
+        name = "pallas:merge"
+        C, m = 2048, 512
+        table = _sds((4, C), jnp.uint32)
+        batch = _sds((4, m), jnp.uint32)
+
+        def fn(t, b):
+            return merge_insert(t, b, block=512)
+
+        jx = _trace(fn, table, batch)
+        return mosaic_kernel_rules(jx, name) + preflight(name, fn, table, batch)
+
+    return [("pallas:compact", compact_run), ("pallas:merge", merge_run)]
+
+
+# --- the sweep --------------------------------------------------------------
+
+
+def build_sweep(full: bool = False) -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    """Every (name, runner) in the sweep. Runners trace lazily, so an
+    ``--only``-filtered run costs only the surfaces it touches (and a
+    ``--rules`` filter naming no jaxpr rule skips the sweep entirely —
+    ``cli.run_lint``)."""
+    from ..service.registry import SHIPPED, resolve
+
+    out: List[Tuple[str, Callable[[], List[Finding]]]] = []
+    for spec in SHIPPED:
+        model, _ = resolve(spec)
+        out.extend(_kernel_surfaces(spec, model))
+        # The accelerator-policy sorted-engine superstep: the program
+        # the chip actually runs for this model (W-dependent sort
+        # widths — STPU003's subject).
+        out.append(_engine_surface(spec, "sorted", _accel_policy_compaction(model)))
+        if full or spec in MATRIX_SPECS:
+            out.append(_engine_surface(spec, "hash", "auto"))
+            out.append(_engine_surface(spec, "delta", "gather"))
+            out.append(_engine_surface(spec, "sorted", "bsearch"))
+            out.append(_engine_surface(spec, "sorted", "pallas"))
+    # Fused multi-level programs (the lax.switch ladder + while loop):
+    # one narrow sorted, one narrow delta (STPU004's switch-carrying
+    # delta program), one wide sorted under --full.
+    out.append(_fused_surface("2pc:3", "sorted"))
+    out.append(_fused_surface("2pc:3", "delta"))
+    if full:
+        out.append(_fused_surface("paxos:2,3", "sorted"))
+    out.extend(_ops_surfaces())
+    out.extend(_pallas_surfaces())
+    return out
+
+
+def run_sweep(
+    full: bool = False,
+    only: Optional[List[str]] = None,
+) -> List[SurfaceReport]:
+    """Trace and scan every surface (CPU backend, accelerator write
+    lowering pinned on). ``only`` filters surface names by substring.
+
+    The sweep is HERMETIC: every ``STPU_*`` env knob is scrubbed for the
+    duration (and restored after). The knobs exist for A/B sessions —
+    an exported ``STPU_SORTEDSET_KEYS=packed`` or ``STPU_COMPACTION``
+    would otherwise make the lint trace a different program than the
+    tree defines (or error outright on x64-requiring variants), turning
+    the verdict into a function of the caller's shell."""
+    import os as _os
+
+    pin_cpu()
+    from .. import packing
+
+    reports: List[SurfaceReport] = []
+    prev = packing.ONE_HOT_WRITES
+    packing.ONE_HOT_WRITES = True
+    scrubbed = {
+        k: _os.environ.pop(k) for k in list(_os.environ) if k.startswith("STPU_")
+    }
+    try:
+        for name, runner in build_sweep(full=full):
+            if only and not any(s in name for s in only):
+                continue
+            t0 = time.monotonic()
+            rep = SurfaceReport(name=name)
+            try:
+                rep.findings = runner()
+            except Exception as e:  # trace failure: loud, not a pass
+                rep.error = f"{type(e).__name__}: {e}"
+            rep.seconds = round(time.monotonic() - t0, 3)
+            reports.append(rep)
+    finally:
+        packing.ONE_HOT_WRITES = prev
+        _os.environ.update(scrubbed)
+    return reports

@@ -153,7 +153,11 @@ def _ring_update(mask_ref, plane_refs, stage, p, B: int):
 
     m = mask_ref[:].astype(jnp.int32)
     incl = tri_inclusive(m, B)
-    n_b = jnp.sum(m)
+    # Block survivor total = the inclusive prefix sum's last element —
+    # NOT jnp.sum(m): Mosaic has no integer-reduction lowering (the
+    # stpu-lint STPU005 pre-flight catches the reduce_sum shape), and
+    # the triangular contraction already computed the answer.
+    n_b = incl[B - 1]
     tgt = jnp.where(m > 0, incl - 1 + p, -1)
     ring_fold(stage, [r[:] for r in plane_refs], tgt, B)
     return n_b

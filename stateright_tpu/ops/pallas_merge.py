@@ -202,8 +202,12 @@ def merge_insert(
         v = jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)  # b index
         # rank_b[u] = #{v : b[v] < a[u]}; rank_a[v] = #{u : a[u] <= b[v]}
         lt_ba = _pair_lt(bkh[None, :], bkl[None, :], akh[:, None], akl[:, None])
-        rank_b = jnp.sum(lt_ba.astype(jnp.int32), axis=1)  # [B]
-        rank_a = jnp.sum((~lt_ba).astype(jnp.int32), axis=0)  # #{a <= b[v]}
+        # Rank counts reduce in f32 (exact: counts <= B << 2^24) — Mosaic
+        # has no integer-reduction lowering (stpu-lint STPU005).
+        rank_b = jnp.sum(lt_ba.astype(jnp.float32), axis=1).astype(jnp.int32)
+        rank_a = jnp.sum((~lt_ba).astype(jnp.float32), axis=0).astype(
+            jnp.int32
+        )  # #{a <= b[v]}
 
         base = i0 + j0 - k * B  # == 0, kept symbolic for clarity
         pos_a = jax.lax.broadcasted_iota(jnp.int32, (B,), 0) + rank_b + base
@@ -260,7 +264,9 @@ def merge_insert(
         p1 = t_cnt - c1 * B
         k_i32 = keep.astype(jnp.int32)
         incl = tri_inclusive(k_i32, B)
-        n_k = jnp.sum(k_i32)
+        # Survivor total = the prefix sum's last element (no integer
+        # reduce_sum in Mosaic; stpu-lint STPU005).
+        n_k = incl[B - 1]
         tgt1 = jnp.where(keep, incl - 1 + p1, -1)
         ring_fold(ring, [mkh, mkl, mvh, mvl], tgt1, B)
         t_cnt = t_cnt + n_k

@@ -116,6 +116,18 @@ def _require_packed(model: Model) -> None:
         )
 
 
+def accel_auto_compaction(state_words: int) -> str:
+    """The planes-compaction mode the ACCELERATOR auto-policy resolves
+    for a model width (the round-5 on-chip verdict: sort-family
+    compaction wins at narrow W; a wide-W sort compaction is a W+3
+    operand ``lax.sort`` whose XLA:TPU compile stalls). ONE definition —
+    ``XlaChecker.__init__`` resolves through it, and stpu-lint
+    (``analysis/surfaces.py``) traces the program it names so STPU003
+    checks the sort widths the chip actually runs; a threshold change
+    here re-aims both."""
+    return "gather" if state_words > 8 else "sort"
+
+
 def capacity_hints(model: Model) -> Dict[str, int]:
     """Capacities learned from growth events in earlier single-chip checks
     of ``model`` (empty if none grew). Hints auto-apply only to DEFAULT
@@ -265,8 +277,8 @@ class XlaChecker(Checker):
         if compaction == "auto":
             compaction = os.environ.get("STPU_COMPACTION") or (
                 "gather"
-                if jax.default_backend() == "cpu" or model.state_words > 8
-                else "sort"
+                if jax.default_backend() == "cpu"
+                else accel_auto_compaction(model.state_words)
             )
         # "pallas": the state-major layout of "bsearch" with the
         # compaction itself as a sequential-grid pallas streaming kernel

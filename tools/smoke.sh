@@ -1,18 +1,27 @@
 #!/usr/bin/env bash
-# Tier-0 smoke: a <6-minute subset to run BEFORE the ~50-minute full
-# suite — the observability schemas (trace/heartbeat/metrics/dispatch_log
-# consumers parse these), one fused-vs-single exactness pin (the engine's
-# semantic contract), one packed-model end-to-end check, a <30s
-# kill-and-resume crash drill (SIGKILL a supervised worker, resume from
-# its auto-checkpoint, exact pinned counts — the recovery stack's tier-0
-# proof), and the <30s SERVICE crash drill (a CheckerService job SIGKILLed
-# mid-superstep requeues, resumes from its per-job checkpoint, exact
-# counts + Chrome trace — the multi-tenant pool's tier-0 proof). A red
-# here means don't bother starting the full run.
+# Tier-0 smoke: a <7-minute subset to run BEFORE the ~50-minute full
+# suite — the lint gate, the observability schemas (trace/heartbeat/
+# metrics/dispatch_log consumers parse these), one fused-vs-single
+# exactness pin (the engine's semantic contract), one packed-model
+# end-to-end check, a <30s kill-and-resume crash drill (SIGKILL a
+# supervised worker, resume from its auto-checkpoint, exact pinned
+# counts — the recovery stack's tier-0 proof), and the <30s SERVICE
+# crash drill (a CheckerService job SIGKILLed mid-superstep requeues,
+# resumes from its per-job checkpoint, exact counts + Chrome trace — the
+# multi-tenant pool's tier-0 proof). A red here means don't bother
+# starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# Lint stage (stpu-lint, docs/static-analysis.md): the pinned
+# backend-miscompile rules enforced over every shipped kernel surface —
+# CPU-only, no device, <60 s. The JSON verdict lands in runs/lint.json,
+# which bench.py folds into bench_detail.json provenance as lint_ok.
+mkdir -p runs
+timeout -k 5 60 python tools/stpu_lint.py --json-out runs/lint.json
+
 exec timeout -k 10 340 python -m pytest \
   tests/test_obs.py \
   tests/test_fused_dispatch.py::test_fused_matches_single_full_coverage \

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""stpu-lint wrapper: ``python tools/stpu_lint.py [args]`` ==
+``python -m stateright_tpu.analysis [args]`` from anywhere.
+
+The analyzer mechanically enforces the pinned backend-miscompile rules
+(docs/static-analysis.md) over every shipped kernel surface: CPU-only,
+no device access, <60 s on the 1-core CI box. ``tools/smoke.sh`` runs it
+as the tier-0 ``lint`` stage with ``--json-out runs/lint.json``, which
+``bench.py`` folds into ``bench_detail.json`` provenance as ``lint_ok``.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from stateright_tpu.analysis import main  # noqa: E402 (path bootstrap)
+
+if __name__ == "__main__":
+    sys.exit(main())
