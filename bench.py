@@ -111,34 +111,68 @@ def _audit(checker) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _artifact_fresh(path: str) -> bool:
+    """Whether a lint-family artifact is FRESH: newer than every package
+    source file and the waiver file. An artifact older than any of its
+    inputs is a verdict about some other tree. Raises on a missing
+    artifact (callers treat any failure as None-provenance)."""
+    mtime = os.path.getmtime(path)
+    inputs = [os.path.join(REPO, ".stpu-lint-waivers.toml")]
+    pkg = os.path.join(REPO, "stateright_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        inputs += [
+            os.path.join(dirpath, fn)
+            for fn in filenames
+            if fn.endswith(".py")
+        ]
+    return all(
+        os.path.getmtime(p) <= mtime
+        for p in inputs
+        if os.path.exists(p)
+    )
+
+
 def _lint_ok() -> bool | None:
     """The stpu-lint verdict from runs/lint.json (written by
     tools/smoke.sh's lint stage / tools/stpu_lint.py --json-out), as
     tri-state provenance: True/False, or None when no artifact exists,
     it does not parse, it records a PARTIAL (--only/--rules filtered)
-    run, or it is STALE — older than the newest package source file or
-    the waiver file, i.e. a verdict about some other tree. An absent,
-    partial, or stale lint run is not a pass."""
+    run, or it is STALE (_artifact_fresh). An absent, partial, or stale
+    lint run is not a pass."""
     try:
         path = os.path.join(RUNS, "lint.json")
-        lint_mtime = os.path.getmtime(path)
-        inputs = [os.path.join(REPO, ".stpu-lint-waivers.toml")]
-        pkg = os.path.join(REPO, "stateright_tpu")
-        for dirpath, dirnames, filenames in os.walk(pkg):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            inputs += [
-                os.path.join(dirpath, fn)
-                for fn in filenames
-                if fn.endswith(".py")
-            ]
-        for p in inputs:
-            if os.path.exists(p) and os.path.getmtime(p) > lint_mtime:
-                return None
+        if not _artifact_fresh(path):
+            return None
         with open(path) as fh:
             report = json.load(fh)
             if report.get("partial"):
                 return None
             return bool(report["ok"])
+    except Exception:
+        return None
+
+
+def _compile_plan() -> dict | None:
+    """STPU007 compile-plan provenance from runs/compile_plan.json (the
+    census a full stpu-lint run banks): per-spec distinct program-shape
+    counts, or None when the artifact is missing, unparseable, or STALE
+    (_artifact_fresh — a census about some other tree). The bench's own
+    run may compile MORE shapes than the census (growth events double
+    capacities); the census records the declared plan."""
+    try:
+        path = os.path.join(RUNS, "compile_plan.json")
+        if not _artifact_fresh(path):
+            return None
+        with open(path) as fh:
+            census = json.load(fh)
+        return {
+            "tree": census.get("tree"),
+            "distinct_programs": {
+                spec: {p: plan["distinct_programs"] for p, plan in plans.items()}
+                for spec, plans in census["specs"].items()
+            },
+        }
     except Exception:
         return None
 
@@ -621,6 +655,10 @@ def _worker(platform: str) -> None:
                     # lint_ok: true — numbers measured on a tree that
                     # violates a pinned-miscompile rule are suspect.
                     "lint_ok": _lint_ok(),
+                    # STPU007 census provenance: the compile-shape plan
+                    # this tree declares (what warm_cache pre-seeds and
+                    # the tunnel window should expect to pay).
+                    "compile_plan": _compile_plan(),
                     "generated_states": states,
                     "unique_states": checker.unique_state_count(),
                     "max_depth": checker.max_depth(),

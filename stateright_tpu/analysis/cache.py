@@ -1,0 +1,128 @@
+"""Content-hash-keyed per-surface result cache for the lint sweep.
+
+The sweep's cost is tracing (and, for STPU008, dual-platform lowering) —
+pure functions of the package source. One ``tree_hash`` over every
+``stateright_tpu/**/*.py`` keys the whole cache: any source edit
+invalidates everything (conservative but correct — a surface's traced
+program can depend on any module), while repeat runs on an unchanged
+tree (the common smoke.sh / admission case) replay findings from disk in
+milliseconds. The waiver file is deliberately NOT in the hash: waivers
+are applied after the sweep, to raw findings, so cached findings stay
+valid across waiver edits.
+
+Entries live under ``runs/lint_cache/<tree12>/<slug>.json`` (``runs/``
+is gitignored); stale tree dirs are pruned on first write so the cache
+never accumulates. ``--no-cache`` forces a fresh sweep; surfaces that
+ERRORED or SKIPPED are never cached (an environment verdict is not a
+tree verdict).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+from typing import List, Optional
+
+from .rules import Finding
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(_PKG)
+DEFAULT_CACHE_DIR = os.path.join(_REPO, "runs", "lint_cache")
+
+_tree_hash_memo: Optional[str] = None
+
+
+def tree_hash(root: str = _PKG) -> str:
+    """sha256 over every package source file (path + content), memoized
+    per process — the key under which cached surface results are valid."""
+    global _tree_hash_memo
+    if _tree_hash_memo is not None and root == _PKG:
+        return _tree_hash_memo
+    h = hashlib.sha256()
+    # The jaxpr/lowering verdicts are functions of the installed jax
+    # too, not just this tree: a jax upgrade must invalidate cached
+    # STPU005 pre-flights and STPU008 inventories. (jax is already
+    # imported by this container's sitecustomize in every process, so
+    # this costs nothing and initializes no backend.)
+    try:
+        import jax
+
+        h.update(jax.__version__.encode())
+    except Exception:  # pragma: no cover - jax-less caller
+        pass
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as fh:
+                h.update(fh.read())
+    digest = h.hexdigest()
+    if root == _PKG:
+        _tree_hash_memo = digest
+    return digest
+
+
+def _slug(surface: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", surface)
+
+
+class SurfaceCache:
+    """get/put of raw (pre-waiver) surface findings under one tree hash."""
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.root = cache_dir or DEFAULT_CACHE_DIR
+        self.tree = tree_hash()[:12]
+        self.dir = os.path.join(self.root, self.tree)
+        self._pruned = False
+
+    def get(self, surface: str) -> Optional[List[Finding]]:
+        path = os.path.join(self.dir, _slug(surface) + ".json")
+        try:
+            with open(path) as fh:
+                rows = json.load(fh)["findings"]
+        except (OSError, json.JSONDecodeError, KeyError):
+            return None
+        try:
+            return [
+                Finding(**{k: r[k] for k in (
+                    "rule", "surface", "file", "line", "message", "excerpt"
+                )})
+                for r in rows
+            ]
+        except (KeyError, TypeError):
+            return None
+
+    def put(self, surface: str, findings: List[Finding]) -> None:
+        # Prune other trees' dirs the first time this instance writes —
+        # the cache holds exactly one tree's results.
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            if not self._pruned:
+                self._pruned = True
+                for d in os.listdir(self.root):
+                    if d != self.tree:
+                        shutil.rmtree(
+                            os.path.join(self.root, d), ignore_errors=True
+                        )
+        except OSError:  # pragma: no cover - cache is best-effort
+            return
+        payload = {
+            "findings": [
+                {k: v for k, v in f.to_json().items()
+                 if k not in ("waived", "waiver_reason")}
+                for f in findings
+            ]
+        }
+        tmp = os.path.join(self.dir, _slug(surface) + ".json.tmp")
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, os.path.join(self.dir, _slug(surface) + ".json"))
+        except OSError:  # pragma: no cover - cache is best-effort
+            pass

@@ -39,11 +39,20 @@ def run_lint(
     only: Optional[List[str]] = None,
     rules: Optional[List[str]] = None,
     waivers_path: Optional[str] = DEFAULT_WAIVERS,
+    admission: Optional[str] = None,
+    use_cache: bool = True,
+    cache_dir: Optional[str] = None,
 ) -> dict:
-    """The whole lint as a dict report (the CLI's JSON schema; tests and
-    bench consume this directly)."""
+    """The whole lint as a dict report (the CLI's JSON schema; tests,
+    bench, and the service's admission gate consume this directly).
+    ``admission`` swaps the sweep for one spec's flight-check subset
+    (kernel rules + lowering diff + compile-plan census — see
+    ``surfaces.build_admission_sweep``); the AST pass is whole-package
+    and is skipped there."""
     t0 = time.monotonic()
     waivers = load_waivers(waivers_path)
+    if admission is not None:
+        ast_pass = False
 
     findings: List[Finding] = []
     surfaces = []
@@ -62,13 +71,21 @@ def run_lint(
     if trace:
         from .surfaces import run_sweep
 
-        for rep in run_sweep(full=full, only=only):
+        for rep in run_sweep(
+            full=full,
+            only=only,
+            admission_spec=admission,
+            use_cache=use_cache,
+            cache_dir=cache_dir,
+        ):
             surfaces.append(
                 {
                     "name": rep.name,
                     "seconds": rep.seconds,
                     "findings": len(rep.findings),
                     "error": rep.error,
+                    "skipped": rep.skipped,
+                    "cached": rep.cached,
                 }
             )
             findings.extend(rep.findings)
@@ -84,23 +101,37 @@ def run_lint(
         findings = [f for f in findings if f.rule in keep]
 
     active, waived, unused = apply_waivers(findings, waivers)
+    # An EXPIRED waiver stopped suppressing (its findings are active
+    # above) and is always reported — unlike merely-stale ones it is
+    # actionable on any run, partial or not.
+    expired = [w for w in unused if w.expired]
     # A filtered run is PARTIAL: its verdict covers only what it swept.
     # Stale-waiver detection is suppressed (a live waiver's findings may
     # simply never have fired), and the flag rides in the report so
     # provenance consumers (bench.py's lint_ok) never mistake a
     # --only/--rules iteration artifact for a full-tree verdict.
-    partial = bool(rules or only or not (trace and ast_pass))
+    partial = bool(
+        rules or only or admission or not (trace and ast_pass)
+    )
     if partial:
-        unused = []
+        unused = expired
     return {
         "ok": not active and not errors,
         "partial": partial,
+        "admission": admission,
         "elapsed_s": round(time.monotonic() - t0, 2),
         "surfaces": surfaces,
         "findings": [f.to_json() for f in active],
         "waived": [f.to_json() for f in waived],
         "unused_waivers": [
-            {"rule": w.rule, "surface": w.surface, "file": w.file, "reason": w.reason}
+            {
+                "rule": w.rule,
+                "surface": w.surface,
+                "file": w.file,
+                "reason": w.reason,
+                "expires": w.expires,
+                "expired": w.expired,
+            }
             for w in unused
         ],
         "errors": errors,
@@ -115,20 +146,88 @@ def _print_human(report: dict) -> None:
             "waived", "waiver_reason")}).format())
     for e in report["errors"]:
         print(f"ERROR: {e}")
+    for s in report["surfaces"]:
+        if s.get("skipped"):
+            print(f"skipped {s['name']}: {s['skipped']}")
     for w in report["unused_waivers"]:
-        print(
-            f"stale waiver (matched nothing): {w['rule']} "
-            f"surface={w['surface']!r} file={w['file']!r} — prune it"
-        )
+        if w.get("expired"):
+            print(
+                f"EXPIRED waiver (no longer suppressing since "
+                f"{w['expires']}): {w['rule']} surface={w['surface']!r} "
+                f"file={w['file']!r} — renew with a fresh justification "
+                "or fix the finding"
+            )
+        else:
+            print(
+                f"stale waiver (matched nothing): {w['rule']} "
+                f"surface={w['surface']!r} file={w['file']!r} — prune it"
+            )
     n_surf = len(report["surfaces"])
+    n_cached = sum(1 for s in report["surfaces"] if s.get("cached"))
     print(
-        f"stpu-lint: {n_surf} surfaces, "
+        f"stpu-lint: {n_surf} surfaces ({n_cached} cached), "
         f"{len(report['findings'])} finding(s), "
         f"{len(report['waived'])} waived, "
         f"{len(report['errors'])} error(s) "
         f"in {report['elapsed_s']}s -> "
         + ("OK" if report["ok"] else "FAIL")
     )
+
+
+def write_sarif(report: dict, path: str) -> None:
+    """The report as SARIF 2.1.0 (code-scanning annotations: one result
+    per finding, waived ones at ``note`` level)."""
+    results = []
+    for f, level in [(f, "error") for f in report["findings"]] + [
+        (f, "note") for f in report["waived"]
+    ]:
+        msg = f["message"]
+        if f.get("waiver_reason"):
+            msg += f" [waived: {f['waiver_reason']}]"
+        result = {
+            "ruleId": f["rule"],
+            "level": level,
+            "message": {"text": f"[{f['surface']}] {msg}"},
+        }
+        if f["file"]:
+            result["locations"] = [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f["file"]},
+                        "region": {"startLine": max(f["line"], 1)},
+                    }
+                }
+            ]
+        results.append(result)
+    sarif = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "stpu-lint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [
+                            {
+                                "id": r.id,
+                                "shortDescription": {"text": r.title},
+                                "fullDescription": {"text": r.history},
+                            }
+                            for r in RULES.values()
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(sarif, fh, indent=1)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -169,6 +268,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         "the matrix on one narrow + one wide model)",
     )
     p.add_argument(
+        "--admission",
+        metavar="SPEC",
+        help="one spec's admission flight-check (kernel rules + lowering "
+        "diff + compile-plan census) — what CheckerService runs at "
+        "submit; implies a partial report",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore the content-hash per-surface result cache "
+        "(runs/lint_cache) and re-trace everything",
+    )
+    p.add_argument(
+        "--sarif",
+        metavar="PATH",
+        help="also write the report as SARIF 2.1.0 (code-scanning "
+        "annotations)",
+    )
+    p.add_argument(
+        "--census-out",
+        metavar="PATH",
+        default=os.path.join(_REPO, "runs", "compile_plan.json"),
+        help="where a full run writes the STPU007 compile-plan census "
+        "(default: runs/compile_plan.json)",
+    )
+    p.add_argument(
         "--list-rules", action="store_true", help="print the rule registry and exit"
     )
     args = p.parse_args(argv)
@@ -194,15 +319,72 @@ def main(argv: Optional[List[str]] = None) -> int:
             only=args.only,
             rules=rules,
             waivers_path=args.waivers,
+            admission=args.admission,
+            use_cache=not args.no_cache,
         )
     except WaiverError as e:
         print(f"waiver file error: {e}", file=sys.stderr)
         return 2
+    except ValueError as e:
+        # Typed caller bugs (unknown --admission spec, bad STPU_FAMILIES
+        # entry): infrastructure verdict, the tree was not verified.
+        # With --json the verdict still goes to stdout as a parseable
+        # not-ok report — the service's admission gate must REJECT a
+        # spec that cannot even resolve (a spec defect), not fail open
+        # as if the lint tool itself had crashed.
+        print(f"stpu-lint error: {e}", file=sys.stderr)
+        if args.json:
+            json.dump(
+                {
+                    "ok": False,
+                    "partial": True,
+                    "admission": args.admission,
+                    "surfaces": [],
+                    "findings": [],
+                    "waived": [],
+                    "unused_waivers": [],
+                    "errors": [f"{type(e).__name__}: {e}"],
+                },
+                sys.stdout,
+                indent=1,
+            )
+            print()
+        return 2
+
+    # A CLEAN full (non-partial, traced) run banks the STPU007 census as
+    # the compile-plan artifact — the warm-cache set and bench
+    # provenance read it (docs/static-analysis.md). A failing or
+    # erroring run banks nothing (the artifact describes a verified
+    # tree), and a census-build crash must not eat the lint report or
+    # the exit-code contract — the sweep's verdict stands either way.
+    if (
+        report["ok"]
+        and not report["partial"]
+        and not args.no_trace
+        and args.census_out
+    ):
+        try:
+            from .cache import tree_hash
+            from .census import build_census
+
+            census = build_census()
+            census["tree"] = tree_hash()[:12]
+            census["generated_unix_ts"] = time.time()
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.census_out)),
+                exist_ok=True,
+            )
+            with open(args.census_out, "w") as fh:
+                json.dump(census, fh, indent=1)
+        except Exception as e:
+            print(f"census bank failed: {e}", file=sys.stderr)
 
     if args.json_out:
         os.makedirs(os.path.dirname(os.path.abspath(args.json_out)), exist_ok=True)
         with open(args.json_out, "w") as fh:
             json.dump(report, fh, indent=1)
+    if args.sarif:
+        write_sarif(report, args.sarif)
     if args.json:
         json.dump(report, sys.stdout, indent=1)
         print()

@@ -29,7 +29,12 @@ from __future__ import annotations
 import os
 from typing import Any, Iterator, List, Optional, Tuple
 
-from .rules import MAX_SAFE_SORT_OPERANDS, Finding
+from .rules import (
+    MAX_SAFE_SORT_OPERANDS,
+    PATHOLOGY_LOWERING_OPS,
+    VMEM_BUDGET_BYTES,
+    Finding,
+)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -159,35 +164,46 @@ def taint_scatters(closed, surface: str) -> List[Finding]:
 
 
 def output_transposes(closed, surface: str) -> List[Finding]:
-    """STPU002: kernel-surface outputs produced directly by a transpose
-    equation — the ``vmap(..., out_axes != 0)`` shape that fuses a
-    transpose INTO the vmapped kernel, which XLA:CPU miscompiles. The
-    engine's safe direction materializes rows and transposes as a
-    separate consumer (rows-in/transpose-out)."""
+    """STPU002: ANY transpose equation inside a kernel-surface jaxpr —
+    whether it produces the surface's outputs directly (the
+    ``vmap(..., out_axes != 0)`` shape) or sits mid-kernel between ops
+    (e.g. a nested ``vmap(..., out_axes != 0)`` whose transpose feeds
+    further kernel ops — the documented gap the first cut of this rule
+    left open). Either way the transpose is FUSED into the vmapped
+    kernel, which is the shape XLA:CPU miscompiles; the engine's safe
+    direction materializes rows and transposes as a separate consumer
+    (rows-in/transpose-out). Shipped kernels carry zero transposes, so
+    the whole-body scan stays noise-free."""
     findings: List[Finding] = []
     jaxpr = closed.jaxpr
     outs = {id(v) for v in jaxpr.outvars if not _is_literal(v)}
-    for eqn in jaxpr.eqns:
+    for eqn, _path in iter_eqns(jaxpr):
         if eqn.primitive.name != "transpose":
             continue
-        if any(id(o) in outs for o in eqn.outvars):
-            file, line = source_of(eqn)
-            findings.append(
-                Finding(
-                    rule="STPU002",
-                    surface=surface,
-                    file=file,
-                    line=line,
-                    message=(
+        direct = any(id(o) in outs for o in eqn.outvars)
+        file, line = source_of(eqn)
+        findings.append(
+            Finding(
+                rule="STPU002",
+                surface=surface,
+                file=file,
+                line=line,
+                message=(
+                    (
                         "vmapped kernel hands its output straight out of "
-                        "a transpose (out_axes != 0): the "
-                        "transpose-fused-into-vmap shape XLA:CPU "
-                        "miscompiles — emit rows (out_axes=0) and "
-                        "transpose outside the kernel"
-                    ),
-                    excerpt=excerpt_of(eqn),
-                )
+                        "a transpose (out_axes != 0)"
+                        if direct
+                        else "transpose buried mid-kernel between ops in "
+                        "a vmapped kernel (e.g. a nested "
+                        "vmap(out_axes != 0))"
+                    )
+                    + ": the transpose-fused-into-vmap shape XLA:CPU "
+                    "miscompiles — emit rows (out_axes=0) and transpose "
+                    "outside the kernel"
+                ),
+                excerpt=excerpt_of(eqn),
             )
+        )
     return findings
 
 
@@ -262,6 +278,134 @@ def cond_flush_sorts(
                     excerpt=excerpt_of(eqn),
                 )
             )
+    return findings
+
+
+# --- STPU006: static VMEM budget for pallas kernels -------------------------
+
+
+def _vmem_bytes(aval) -> int:
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", None)
+    if itemsize is None:  # extended dtypes (semaphores) are space-filtered
+        itemsize = 4
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(itemsize)
+
+
+def pallas_vmem_footprint(eqn) -> Tuple[int, List[str]]:
+    """Static per-core VMEM bytes of one ``pallas_call`` equation, from
+    the kernel jaxpr's ref avals: blocked operands (default memory
+    space) count TWICE — the pipeline emitter double-buffers them —
+    VMEM scratch counts in full, and ANY (HBM) / SMEM / semaphore refs
+    are free. Returns ``(bytes, breakdown)``."""
+    total = 0
+    breakdown: List[str] = []
+    kernel = eqn.params.get("jaxpr")
+    if kernel is None:  # not a shape this pass prices
+        return 0, []
+    for v in kernel.invars:
+        aval = v.aval
+        space = getattr(aval, "memory_space", None)
+        tag = str(getattr(space, "value", space)).lower()
+        if space is None:
+            b = 2 * _vmem_bytes(aval)  # double-buffered pipeline block
+            label = "block x2"
+        elif tag == "vmem":
+            b = _vmem_bytes(aval)
+            label = "scratch"
+        else:  # any (HBM), smem, semaphores
+            continue
+        total += b
+        breakdown.append(
+            f"{label} {tuple(getattr(aval, 'shape', ()))} = {b}B"
+        )
+    return total, breakdown
+
+
+def vmem_budget(
+    closed, surface: str, budget: int = VMEM_BUDGET_BYTES
+) -> List[Finding]:
+    """STPU006: every ``pallas_call`` whose static VMEM footprint
+    exceeds the per-core budget (the shape that today surfaces as a
+    runtime Mosaic allocation error on chip, after the tunnel window is
+    already spent)."""
+    findings: List[Finding] = []
+    for eqn, _path in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        total, breakdown = pallas_vmem_footprint(eqn)
+        if total > budget:
+            file, line = source_of(eqn)
+            findings.append(
+                Finding(
+                    rule="STPU006",
+                    surface=surface,
+                    file=file,
+                    line=line,
+                    message=(
+                        f"static VMEM footprint {total} B exceeds the "
+                        f"per-core budget {budget} B "
+                        f"({', '.join(breakdown)}): shrink the block "
+                        "(STPU_PALLAS_BLOCK) or the scratch rings — on "
+                        "chip this is a runtime Mosaic allocation error"
+                    ),
+                    excerpt=excerpt_of(eqn),
+                )
+            )
+    return findings
+
+
+# --- STPU008: cross-backend lowering diff ------------------------------------
+
+#: Dialects whose ops count as the lowered inventory.
+_OP_RE = None
+
+
+def op_inventory(stablehlo_text: str) -> set:
+    """The set of ``stablehlo.*``/``chlo.*``/``mhlo.*`` op names
+    appearing in a lowered module's text."""
+    import re
+
+    global _OP_RE
+    if _OP_RE is None:
+        _OP_RE = re.compile(r"\b(?:stablehlo|chlo|mhlo)\.[\w.]+")
+    return set(_OP_RE.findall(stablehlo_text))
+
+
+def diff_lowering_inventories(
+    surface: str, cpu_ops: set, tpu_ops: set
+) -> List[Finding]:
+    """STPU008: pathology-registry ops present in exactly ONE backend's
+    lowering of the same program — the structural class both pinned
+    miscompiles belong to (TPU drops the scatter CPU executes; CPU
+    miscompiles the transpose TPU runs fine)."""
+    findings: List[Finding] = []
+    for op in PATHOLOGY_LOWERING_OPS:
+        in_cpu, in_tpu = op in cpu_ops, op in tpu_ops
+        if in_cpu == in_tpu:
+            continue
+        only, missing = ("cpu", "tpu") if in_cpu else ("tpu", "cpu")
+        findings.append(
+            Finding(
+                rule="STPU008",
+                surface=surface,
+                file="",
+                line=0,
+                message=(
+                    f"pathology-registry op {op} appears only in the "
+                    f"{only} lowering (absent from {missing}): the "
+                    "backends lower this program differently in exactly "
+                    "the op class they have already disagreed on — "
+                    "rewrite the program so both lowerings agree, or "
+                    "waive with a chip-verified justification"
+                ),
+                excerpt=f"{only}-only: {op}",
+            )
+        )
     return findings
 
 

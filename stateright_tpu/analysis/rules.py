@@ -104,6 +104,46 @@ RULES: Dict[str, Rule] = {
             "the r5e rework banned.",
         ),
         Rule(
+            "STPU006",
+            "Pallas kernel VMEM footprint within the per-core budget",
+            "jaxpr",
+            "An oversized block turns into a runtime Mosaic allocation "
+            "error ON CHIP — after a tunnel window was already spent "
+            "compiling it. The footprint is statically derivable from the "
+            "pallas_call BlockSpecs/avals (blocked operands are "
+            "double-buffered by the pipeline emitter, VMEM scratch is "
+            "resident in full), so the flight-check prices every kernel "
+            "across the supported STPU_PALLAS_BLOCK range against the "
+            "~16 MiB/core v5e budget before any chip time is booked.",
+        ),
+        Rule(
+            "STPU007",
+            "compile-plan shape count within the declared budget",
+            "jaxpr",
+            "Compile time, not run time, burned the round-4/5 windows "
+            "(paxos warm 47 s at 4 buckets on CPU; ~1 min per bucket over "
+            "the tunnel; VERDICT item 6 lost a window to first-compile "
+            "latency). The (bucket, cand-rung) schedule a run plan commits "
+            "to is statically enumerable from the shared ladder planner "
+            "(xla.ladder_buckets/cand_rungs), so a plan whose distinct "
+            "program count blows the budget is a finding before it is a "
+            "burned window. The census doubles as the warm-cache set "
+            "(tools/warm_cache.py derives from it).",
+        ),
+        Rule(
+            "STPU008",
+            "no pathology-class op in only ONE backend's lowering",
+            "jaxpr",
+            "Both pinned miscompiles are the same structural class: an op "
+            "the two backends lower DIFFERENTLY (TPU drops the vmapped "
+            "scatter CPU executes; CPU miscompiles the fused transpose TPU "
+            "runs fine). Lowering every kernel surface for both platforms "
+            "from this CPU box (the STPU005 pre-flight trick) and diffing "
+            "the StableHLO op inventories catches a registry-class op that "
+            "appears on one side only — the shape where the backends have "
+            "already disagreed twice.",
+        ),
+        Rule(
             "STPU101",
             "traced-index packed-field writes go through packing",
             "ast",
@@ -142,6 +182,32 @@ RULES: Dict[str, Rule] = {
 #: compile stall was at 28 (W=25). Conservative midpoint: anything
 #: above 16 operands is the stall shape.
 MAX_SAFE_SORT_OPERANDS = 16
+
+#: STPU006's per-core VMEM budget: ~16 MiB on the v5e class this project
+#: targets (the Pallas guide's memory-hierarchy table). The footprint
+#: model charges blocked operands twice (the pipeline emitter
+#: double-buffers them) and VMEM scratch in full; SMEM/semaphores/ANY
+#: (HBM) operands are free.
+VMEM_BUDGET_BYTES = 16 * 2**20
+
+#: STPU007's default compile budget: distinct (bucket, rung-schedule)
+#: programs a run plan may commit to. Every shipped plan sits at 3-4
+#: buckets; 8 is the "a window will burn on compiles" line (~1 min per
+#: bucket over the tunnel). A model may declare its own via an
+#: ``xla_compile_budget`` attribute.
+MAX_COMPILE_SHAPES = 8
+
+#: STPU008's pathology registry: lowered-op classes a backend has
+#: already miscompiled, dropped, or stalled on. An op from this set in
+#: only ONE backend's StableHLO lowering of the same program is the
+#: structural shape both pinned miscompiles belong to.
+PATHOLOGY_LOWERING_OPS = (
+    "stablehlo.scatter",          # the STPU001 dropped-write class
+    "stablehlo.transpose",        # the STPU002 fused-transpose class
+    "stablehlo.sort",             # the STPU003 compile-stall class
+    "stablehlo.dynamic_update_slice",  # scatter's one-element sibling
+    "stablehlo.select_and_scatter",
+)
 
 
 @dataclass
@@ -185,11 +251,28 @@ class Waiver:
     reason: str
     surface: str = "*"
     file: str = "*"
+    #: Optional ``YYYY-MM-DD`` expiry. Past it the waiver STOPS
+    #: suppressing (its findings go active) and it is reported like a
+    #: stale one — so a chip-A/B-pending waiver cannot rot past its
+    #: window. Empty = never expires.
+    expires: str = ""
     used: int = field(default=0, compare=False)
+
+    @property
+    def expired(self) -> bool:
+        if not self.expires:
+            return False
+        import datetime
+
+        return (
+            datetime.date.fromisoformat(self.expires)
+            < datetime.date.today()
+        )
 
     def matches(self, f: Finding) -> bool:
         return (
-            f.rule == self.rule
+            not self.expired
+            and f.rule == self.rule
             and fnmatch.fnmatchcase(f.surface, self.surface)
             and fnmatch.fnmatchcase(f.file, self.file)
         )
@@ -219,15 +302,15 @@ def _parse_waivers_toml(text: str, path: str) -> List[Waiver]:
             key, _, val = line.partition("=")
             key = key.strip()
             val = val.strip()
-            if key in ("rule", "reason", "surface", "file") and (
+            if key in ("rule", "reason", "surface", "file", "expires") and (
                 len(val) >= 2 and val[0] == '"' and val[-1] == '"'
             ):
                 current[key] = val[1:-1]
                 continue
         raise WaiverError(
             f"{path}:{lineno}: unsupported waiver syntax {raw!r} "
-            "(only [[waiver]] tables with rule/reason/surface/file "
-            'string keys, e.g. rule = "STPU001")'
+            "(only [[waiver]] tables with rule/reason/surface/file/"
+            'expires string keys, e.g. rule = "STPU001")'
         )
     if current is not None:
         waivers.append(_finish_waiver(current, path))
@@ -248,6 +331,16 @@ def _finish_waiver(d: dict, path: str) -> Waiver:
         )
     if not d["reason"].strip():
         raise WaiverError(f"{path}:{line}: empty waiver reason")
+    if d.get("expires"):
+        import datetime
+
+        try:
+            datetime.date.fromisoformat(d["expires"])
+        except ValueError:
+            raise WaiverError(
+                f"{path}:{line}: expires must be YYYY-MM-DD, got "
+                f"{d['expires']!r}"
+            ) from None
     return Waiver(**d)
 
 

@@ -37,15 +37,19 @@ the whole matrix for every spec.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
 from .jaxpr_lint import (
     cond_flush_sorts,
+    diff_lowering_inventories,
     mosaic_kernel_rules,
+    op_inventory,
     output_transposes,
     taint_scatters,
+    vmem_budget,
     wide_sorts,
 )
 from .rules import Finding
@@ -77,6 +81,23 @@ TABLE_CAP_DELTA = 1 << 15
 #: splits on).
 MATRIX_SPECS = ("2pc:3", "paxos:2,3")
 
+#: The STPU_PALLAS_BLOCK values STPU006 prices each pallas kernel at:
+#: the shipped default (512) and its supported neighbours. The VMEM
+#: footprint scales with the block, so the budget must hold across the
+#: whole range an A/B session can select.
+SUPPORTED_PALLAS_BLOCKS = (256, 512, 1024)
+
+#: The virtual CPU mesh width the sharded-engine surface traces under —
+#: the same 8-device mesh tests/conftest.py forces for the mesh tests.
+MESH_DEVICES = 8
+
+
+class SurfaceSkip(Exception):
+    """A surface that cannot run in THIS environment (e.g. the sharded
+    surface without the 8-device virtual mesh) — reported with its
+    reason, not an error: the environment, not the tree, is the cause,
+    exactly like the distributed-mesh tests' probe-and-self-skip."""
+
 
 @dataclass
 class SurfaceReport:
@@ -87,6 +108,12 @@ class SurfaceReport:
     #: failure, not a rule finding — the CLI exits 2 on these: a surface
     #: that cannot be checked is not a pass).
     error: str = ""
+    #: Non-empty when the surface self-skipped (environment limitation,
+    #: not a failure; the reason is the probe's verdict).
+    skipped: str = ""
+    #: Whether the findings came from the content-hash result cache
+    #: (analysis/cache.py) instead of a fresh trace.
+    cached: bool = False
 
 
 def pin_cpu() -> None:
@@ -94,9 +121,18 @@ def pin_cpu() -> None:
     any jax backend use (env alone cannot override the sitecustomize's
     config-level accelerator pin — CLAUDE.md gotcha #2). Guarded: on a
     jax lineage where a post-init update raises, an already-CPU process
-    proceeds; anything else is a real configuration error."""
+    proceeds; anything else is a real configuration error. Also asks the
+    CPU client for the 8-device virtual mesh (read at CPU-client init,
+    so it must be set here, before the first backend use) so the sharded
+    engine surface can trace — a backend that initialized earlier with
+    fewer devices makes that one surface self-skip, never fail."""
     import jax
 
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={MESH_DEVICES}"
+        ).strip()
     try:
         jax.config.update("jax_platforms", "cpu")
     except RuntimeError:  # pragma: no cover - backend already initialized
@@ -186,6 +222,28 @@ def _kernel_surfaces(spec: str, model) -> List[Tuple[str, Callable[[], List[Find
 
     out.append((name, run_planes))
     return out
+
+
+def _lowering_surface(spec: str, model) -> Tuple[str, Callable[[], List[Finding]]]:
+    """STPU008: lower the spec's transition kernel for BOTH platforms
+    from this CPU box (no device — the STPU005 pre-flight trick) and
+    diff the StableHLO op inventories for pathology-registry ops that
+    appear on one side only."""
+    name = f"lower:{spec}:packed_step"
+
+    def run():
+        jax, jnp = _jnp()
+        rows = _sds((KERNEL_BATCH, model.state_words), jnp.uint32)
+        fn = jax.vmap(model.packed_step)
+        inv = {}
+        for platform in ("cpu", "tpu"):
+            lowered = jax.jit(fn).trace(rows).lower(
+                lowering_platforms=(platform,)
+            )
+            inv[platform] = op_inventory(lowered.as_text())
+        return diff_lowering_inventories(name, inv["cpu"], inv["tpu"])
+
+    return name, run
 
 
 def _superstep_args(checker, model, f_cap: int):
@@ -360,7 +418,11 @@ def _pallas_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
             return compact_pallas_staged(m, list(ls), cap, block=512)
 
         jx = _trace(fn, mask, *lanes)
-        return mosaic_kernel_rules(jx, name) + preflight(name, fn, mask, *lanes)
+        return (
+            mosaic_kernel_rules(jx, name)
+            + vmem_budget(jx, name)
+            + preflight(name, fn, mask, *lanes)
+        )
 
     def merge_run():
         from ..ops.pallas_merge import merge_insert
@@ -374,9 +436,108 @@ def _pallas_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
             return merge_insert(t, b, block=512)
 
         jx = _trace(fn, table, batch)
-        return mosaic_kernel_rules(jx, name) + preflight(name, fn, table, batch)
+        return (
+            mosaic_kernel_rules(jx, name)
+            + vmem_budget(jx, name)
+            + preflight(name, fn, table, batch)
+        )
 
-    return [("pallas:compact", compact_run), ("pallas:merge", merge_run)]
+    def vmem_block_run(block: int):
+        """STPU006 across the supported STPU_PALLAS_BLOCK range: both
+        kernels re-traced at this block (shapes sized block-divisible)
+        and priced against the per-core budget. The full rule scans ride
+        the default-block surfaces above; these price the block knob."""
+
+        def run():
+            from ..ops.pallas_compact import compact_pallas_staged
+            from ..ops.pallas_merge import merge_insert
+
+            name = f"pallas:vmem:block{block}"
+            M = 4 * block
+            mask = _sds((M,), jnp.bool_)
+            lanes = [_sds((M,), jnp.uint32) for _ in range(4)]
+
+            def cfn(m, *ls):
+                return compact_pallas_staged(m, list(ls), M, block=block)
+
+            out = vmem_budget(_trace(cfn, mask, *lanes), name)
+            table = _sds((4, 4 * block), jnp.uint32)
+            batch = _sds((4, block), jnp.uint32)
+
+            def mfn(t, b):
+                return merge_insert(t, b, block=block)
+
+            return out + vmem_budget(_trace(mfn, table, batch), name)
+
+        return run
+
+    out = [("pallas:compact", compact_run), ("pallas:merge", merge_run)]
+    out += [
+        (f"pallas:vmem:block{b}", vmem_block_run(b))
+        for b in SUPPORTED_PALLAS_BLOCKS
+        if b != 512  # the default block is priced by the surfaces above
+    ]
+    return out
+
+
+def _sharded_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    """The fingerprint-sharded mesh engine's superstep, traced under the
+    same 8-device virtual CPU mesh the distributed tests force — the
+    second surface docs/static-analysis.md listed as missing. Both dedup
+    configs the mesh runs: hash (the CPU/test config) and sorted (the
+    accelerator config STPU003's sort widths apply to)."""
+
+    def make(dedup: str):
+        name = f"engine:2pc:3:sharded-superstep:{dedup}"
+
+        def run():
+            jax, jnp = _jnp()
+            if len(jax.devices()) < MESH_DEVICES:
+                raise SurfaceSkip(
+                    f"needs the {MESH_DEVICES}-device virtual CPU mesh "
+                    f"(backend initialized with {len(jax.devices())} "
+                    "devices before the analyzer could request it)"
+                )
+            from ..parallel import default_mesh
+            from ..service.registry import resolve
+
+            model, _ = resolve("2pc:3")
+            checker = model.checker().spawn_xla(
+                mesh=default_mesh(MESH_DEVICES),
+                dedup=dedup,
+                frontier_capacity=1 << 10,
+                table_capacity=1 << 13,
+            )
+            step = checker._superstep()
+            jx = _trace(
+                step,
+                checker._frontier,
+                checker._frontier_ebits,
+                checker._counts,
+                tuple(checker._table),
+                checker._disc_found,
+                checker._disc_fp,
+            )
+            return wide_sorts(jx, name) + mosaic_kernel_rules(jx, name)
+
+        return name, run
+
+    return [make("hash"), make("sorted")]
+
+
+def _census_surface(
+    specs: Optional[List[str]] = None,
+) -> Tuple[str, Callable[[], List[Finding]]]:
+    """STPU007: the compile-plan census over the shipped specs (or one
+    admission spec) — pure planner arithmetic, no tracing."""
+    name = "plan:shipped" if specs is None else f"plan:{','.join(specs)}"
+
+    def run():
+        from .census import build_census, census_findings
+
+        return census_findings(build_census(specs))
+
+    return name, run
 
 
 # --- the sweep --------------------------------------------------------------
@@ -402,6 +563,13 @@ def build_sweep(full: bool = False) -> List[Tuple[str, Callable[[], List[Finding
             out.append(_engine_surface(spec, "delta", "gather"))
             out.append(_engine_surface(spec, "sorted", "bsearch"))
             out.append(_engine_surface(spec, "sorted", "pallas"))
+        # STPU008's dual-platform lowering costs real seconds per
+        # surface; the default sweep diffs the two width classes (engine
+        # programs are W-class-shared; kernels differ per model, so
+        # --full widens to every spec). Admission checks always diff the
+        # admitted spec (build_admission_sweep).
+        if full or spec in MATRIX_SPECS:
+            out.append(_lowering_surface(spec, model))
     # Fused multi-level programs (the lax.switch ladder + while loop):
     # one narrow sorted, one narrow delta (STPU004's switch-carrying
     # delta program), one wide sorted under --full.
@@ -409,48 +577,123 @@ def build_sweep(full: bool = False) -> List[Tuple[str, Callable[[], List[Finding
     out.append(_fused_surface("2pc:3", "delta"))
     if full:
         out.append(_fused_surface("paxos:2,3", "sorted"))
+    out.extend(_sharded_surfaces())
     out.extend(_ops_surfaces())
     out.extend(_pallas_surfaces())
+    out.append(_census_surface())
+    return out
+
+
+def build_admission_sweep(
+    spec: str,
+) -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    """The admission-time flight-check for ONE spec (docs/service.md):
+    its kernel surfaces (STPU001/002/003), its cross-backend lowering
+    diff (STPU008), and its compile-plan census (STPU007) — the subset
+    a user-submitted model must pass before the pool schedules it on
+    the device. Engine/ops/pallas surfaces are spec-independent and
+    stay the full sweep's business."""
+    from ..service.registry import resolve
+
+    model, _ = resolve(spec)
+    out = _kernel_surfaces(spec, model)
+    out.append(_lowering_surface(spec, model))
+    out.append(_census_surface([spec]))
     return out
 
 
 def run_sweep(
     full: bool = False,
     only: Optional[List[str]] = None,
+    *,
+    admission_spec: Optional[str] = None,
+    use_cache: bool = True,
+    cache_dir: Optional[str] = None,
 ) -> List[SurfaceReport]:
     """Trace and scan every surface (CPU backend, accelerator write
-    lowering pinned on). ``only`` filters surface names by substring.
+    lowering pinned on). ``only`` filters surface names by substring;
+    ``admission_spec`` swaps the sweep for :func:`build_admission_sweep`
+    over that one spec.
 
     The sweep is HERMETIC: every ``STPU_*`` env knob is scrubbed for the
     duration (and restored after). The knobs exist for A/B sessions —
     an exported ``STPU_SORTEDSET_KEYS=packed`` or ``STPU_COMPACTION``
     would otherwise make the lint trace a different program than the
     tree defines (or error outright on x64-requiring variants), turning
-    the verdict into a function of the caller's shell."""
+    the verdict into a function of the caller's shell. The one
+    exemption is ``STPU_FAMILIES`` (service/registry.py's user-family
+    hook): it selects WHICH models exist, not how a program lowers, and
+    scrubbing it would make the admission check unable to see the very
+    spec it was asked to verify.
+
+    ``use_cache`` replays raw findings from the content-hash cache
+    (analysis/cache.py) for surfaces whose package tree is unchanged —
+    errors and skips are never cached."""
     import os as _os
 
+    # Snapshot BEFORE pin_cpu appends the 8-virtual-device flag for the
+    # sharded mesh surface: once the backend is initialized (the flag is
+    # only read at CPU-client init) the caller's value is restored in
+    # the finally below, so subprocesses an embedding process spawns
+    # later never inherit it.
+    prev_flags = _os.environ.get("XLA_FLAGS")
     pin_cpu()
     from .. import packing
+
+    cache = None
+    if use_cache and admission_spec is not None:
+        # A user-submitted family (STPU_FAMILIES) lives OUTSIDE the
+        # package tree the cache hashes — serving its surfaces from the
+        # tree-keyed cache would replay stale verdicts across user
+        # edits. Shipped families stay cacheable.
+        from ..service.registry import FAMILIES, parse
+
+        family, _ = parse(admission_spec)
+        use_cache = family in FAMILIES
+    if use_cache:
+        from .cache import SurfaceCache
+
+        cache = SurfaceCache(cache_dir)
 
     reports: List[SurfaceReport] = []
     prev = packing.ONE_HOT_WRITES
     packing.ONE_HOT_WRITES = True
     scrubbed = {
-        k: _os.environ.pop(k) for k in list(_os.environ) if k.startswith("STPU_")
+        k: _os.environ.pop(k)
+        for k in list(_os.environ)
+        if k.startswith("STPU_") and k != "STPU_FAMILIES"
     }
     try:
-        for name, runner in build_sweep(full=full):
+        sweep = (
+            build_admission_sweep(admission_spec)
+            if admission_spec is not None
+            else build_sweep(full=full)
+        )
+        for name, runner in sweep:
             if only and not any(s in name for s in only):
                 continue
             t0 = time.monotonic()
             rep = SurfaceReport(name=name)
-            try:
-                rep.findings = runner()
-            except Exception as e:  # trace failure: loud, not a pass
-                rep.error = f"{type(e).__name__}: {e}"
+            hit = cache.get(name) if cache is not None else None
+            if hit is not None:
+                rep.findings = hit
+                rep.cached = True
+            else:
+                try:
+                    rep.findings = runner()
+                    if cache is not None:
+                        cache.put(name, rep.findings)
+                except SurfaceSkip as e:
+                    rep.skipped = str(e)
+                except Exception as e:  # trace failure: loud, not a pass
+                    rep.error = f"{type(e).__name__}: {e}"
             rep.seconds = round(time.monotonic() - t0, 3)
             reports.append(rep)
     finally:
         packing.ONE_HOT_WRITES = prev
         _os.environ.update(scrubbed)
+        if prev_flags is None:
+            _os.environ.pop("XLA_FLAGS", None)
+        else:
+            _os.environ["XLA_FLAGS"] = prev_flags
     return reports

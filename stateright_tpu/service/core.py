@@ -72,9 +72,21 @@ SERVICE_COUNTERS = (
     "breaker_closes",
     "degraded_jobs",
     "device_probes",
+    "lint_checks",
+    "lint_rejects",
+    "lint_errors",
 )
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
+#: The admission flight-check entry point (stpu-lint's --admission mode;
+#: docs/static-analysis.md). A subprocess, like every other jax touch —
+#: the service process stays import-clean of jax even while it VERIFIES
+#: jax programs.
+_LINT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tools",
+    "stpu_lint.py",
+)
 
 
 class AdmissionError(Exception):
@@ -120,6 +132,17 @@ class ServiceConfig:
     #: pays full plugin init in a throwaway subprocess, exactly like
     #: ``backend.ensure_live_backend``'s probe.
     probe_argv: Optional[Sequence[str]] = None
+    # -- admission flight-check (stpu-lint --admission) --------------------
+    #: Statically lint a spec's kernel surfaces (STPU001/002/003), its
+    #: cross-backend lowering diff (STPU008), and its compile plan
+    #: (STPU007) before the pool schedules it on the device — the gate
+    #: user-submitted specs (STPU_FAMILIES) pass through. Runs as a
+    #: subprocess (the service never imports jax) and is double-cached:
+    #: the linter's content-hash surface cache makes shipped specs cost
+    #: one jax import (~2 s), and a per-service memo makes repeat
+    #: submissions of the same spec free.
+    admission_lint: bool = True
+    lint_timeout_s: float = 240.0
     # -- workers -----------------------------------------------------------
     platform: str = "default"  #: "default" (accelerator) | "cpu" (tests)
     compile_cache: Optional[str] = None  #: default: <cwd>/.jax_cache
@@ -159,6 +182,7 @@ class Job:
         self.consumed_s = 0.0
         self.requeue_at = 0.0  #: monotonic; quarantine release time
         self.resumed_from: Optional[str] = None  #: last attempt's resume
+        self.lint: Optional[Dict[str, Any]] = None  #: admission flight-check
         self.result: Optional[Dict[str, Any]] = None
         self.error: Optional[str] = None
         self.created_unix_ts = time.time()
@@ -212,6 +236,7 @@ class Job:
             "requeues": self.requeues,
             "attempts": len(self.attempts),
             "resumed_from": self.resumed_from,
+            "lint": self.lint,
             "error": self.error,
         }
         if self.result is not None:
@@ -256,6 +281,18 @@ class CheckerService:
         self._breaker_opened_unix_ts: Optional[float] = None
         self._closed = False
         self._next_id = 0
+        #: Per-service admission-lint memo (spec -> verdict): a pool
+        #: outlives none of the tree edits that would invalidate it, so
+        #: one subprocess per distinct SHIPPED spec per service
+        #: lifetime. User-family specs (STPU_FAMILIES) are never
+        #: memoized — their source lives outside the tree, and a user
+        #: who fixes (or breaks) their model mid-pool must get a fresh
+        #: verdict, mirroring the linter's own cache bypass.
+        self._lint_memo: Dict[str, Dict[str, Any]] = {}
+        #: In-flight lint checks (spec -> Event): concurrent submissions
+        #: of the same uncached spec wait for one subprocess instead of
+        #: each paying a cold check serially on this 1-core box.
+        self._lint_inflight: Dict[str, threading.Event] = {}
         self._scheduler: Optional[threading.Thread] = None
         self._prober: Optional[threading.Thread] = None
         self._session_dir: Optional[str] = None
@@ -344,6 +381,113 @@ class CheckerService:
             self._cfg.max_seconds_cap,
         )
 
+    def _budget_rejection(
+        self, max_seconds: float, max_states: Optional[int]
+    ) -> Optional[str]:
+        """The ONE budget/caps validator: the rejection reason, or None
+        when the budgets are servable. Shared by submit()'s pre-lint
+        precheck and its under-lock authoritative rejection so the two
+        can never drift (a drifted precheck would admit an unlinted
+        job)."""
+        if not 0 < max_seconds <= self._cfg.max_seconds_cap:
+            return (
+                f"max_seconds {max_seconds:.0f} outside the servable "
+                f"range (0, {self._cfg.max_seconds_cap:.0f}]"
+            )
+        if (
+            self._cfg.max_states_cap is not None
+            and max_states is not None
+            and max_states > self._cfg.max_states_cap
+        ):
+            return (
+                f"max_states {max_states} exceeds the pool cap "
+                f"{self._cfg.max_states_cap}"
+            )
+        return None
+
+    def _admission_verdict(self, spec: str) -> Dict[str, Any]:
+        """One spec's admission flight-check verdict (memoized per
+        service): the relevant kernel-surface subset of stpu-lint run in
+        a subprocess (``--admission``, docs/static-analysis.md). The
+        verdict dict rides into ``Job.lint`` (and so the job snapshot
+        and ``/.pool``). ``ok`` is tri-state: True/False are the
+        linter's word; None means the CHECK failed (timeout, crash,
+        unparseable output) — the pool fails OPEN on that (the device
+        still has per-job fault isolation behind it) but records it as
+        ``lint_errors`` so an operator sees a blind gate."""
+        family, _ = registry.parse(spec)
+        memoizable = family in registry.FAMILIES  # user families: never
+        while True:
+            with self._lock:
+                memo = self._lint_memo.get(spec) if memoizable else None
+                if memo is not None:
+                    return dict(memo, cached=True)
+                waiter = self._lint_inflight.get(spec)
+                if waiter is None:
+                    self._lint_inflight[spec] = threading.Event()
+                    self._counters.inc("lint_checks")
+                    break
+            # Another thread is checking this spec: wait for its
+            # verdict, then loop to read the memo (or run our own check
+            # if it wasn't memoizable / errored).
+            waiter.wait(timeout=self._cfg.lint_timeout_s + 30.0)
+        argv = [sys.executable, _LINT, "--admission", spec, "--json"]
+        verdict: Dict[str, Any]
+        try:
+            try:
+                proc = subprocess.run(
+                    argv,
+                    timeout=self._cfg.lint_timeout_s,
+                    capture_output=True,
+                    text=True,
+                )
+                report = json.loads(proc.stdout)
+                verdict = {
+                    "ok": bool(report["ok"]),
+                    "findings": [
+                        {k: f[k] for k in ("rule", "surface", "message")}
+                        for f in report["findings"]
+                    ],
+                    "waived": len(report["waived"]),
+                    "errors": report["errors"],
+                    "cached": False,
+                }
+            except (
+                subprocess.TimeoutExpired,
+                OSError,
+                json.JSONDecodeError,
+                KeyError,
+            ) as e:
+                verdict = {
+                    "ok": None,
+                    "findings": [],
+                    "waived": 0,
+                    "errors": [
+                        f"admission lint failed: {type(e).__name__}: {e}"
+                    ],
+                    "cached": False,
+                }
+            with self._lock:
+                if verdict["ok"] is None:
+                    # A TOOLING failure is not a verdict about the spec:
+                    # count it, fail open for THIS submission, but do
+                    # NOT memoize — the next submission retries the
+                    # check, so one transient timeout can't disable the
+                    # gate for a spec for the rest of the service's
+                    # life.
+                    self._counters.inc("lint_errors")
+                elif memoizable:
+                    self._lint_memo[spec] = verdict
+        finally:
+            # Always release waiters, even on an unexpected error — a
+            # leaked in-flight entry would spin every later submitter of
+            # this spec through wait-timeout loops forever.
+            with self._lock:
+                waiter = self._lint_inflight.pop(spec, None)
+            if waiter is not None:
+                waiter.set()
+        return verdict
+
     def submit(
         self,
         spec: str,
@@ -355,34 +499,76 @@ class CheckerService:
         """Queues one batch checking job; returns its :class:`Job` handle
         or raises :class:`AdmissionError` (queue full → carries
         ``retry_after_s``; an over-cap budget → no retry hint, shrink the
-        request). Unknown/malformed specs raise ``ValueError`` before any
-        admission accounting."""
+        request; an unwaived flight-check finding → no retry hint, fix
+        the spec). Unknown/malformed specs raise ``ValueError`` before
+        any admission accounting."""
         registry.parse(spec)  # typed spec validation, pre-admission
+        with self._lock:
+            # Pre-flight closed check: a closed pool must reject
+            # immediately (the old contract), not after a cold lint
+            # subprocess. The post-lint re-check under the lock still
+            # guards the race.
+            if self._closed:
+                raise RuntimeError("service is closed")
         max_seconds = (
             self._cfg.default_max_seconds if max_seconds is None else max_seconds
+        )
+        # Budget validation BEFORE the flight-check (ONE definition —
+        # the same validator rejects under the lock below): a request
+        # the range checks reject anyway must not pay a cold lint
+        # subprocess. Same for a full queue: the precheck is racy (the
+        # authoritative check below still holds the lock), but a retry
+        # loop against a saturated pool must not keep the 1-core box
+        # pinned on lint subprocesses for doomed submissions.
+        budget_reason = self._budget_rejection(max_seconds, max_states)
+        queue_full = False
+        if budget_reason is None and self._cfg.admission_lint:
+            with self._lock:
+                counts = self._counts()
+                queue_full = (
+                    counts["queued"] + counts["quarantined"]
+                    >= self._cfg.max_queue
+                )
+        # The flight-check runs OUTSIDE the lock (a cold check is a
+        # subprocess); scheduling state is only touched afterwards.
+        lint = (
+            self._admission_verdict(spec)
+            if self._cfg.admission_lint
+            and budget_reason is None
+            and not queue_full
+            else None
         )
         with self._cond:
             if self._closed:
                 raise RuntimeError("service is closed")
             self._counters.inc("submitted")
-            if not 0 < max_seconds <= self._cfg.max_seconds_cap:
+            if lint is not None and lint["ok"] is False:
+                # A typed rejection with NO retry hint: retrying the
+                # same spec cannot help — the finding is in the model's
+                # kernels (or its compile plan), not in pool pressure.
                 self._counters.inc("rejected")
-                raise AdmissionError(
-                    f"max_seconds {max_seconds:.0f} outside the servable "
-                    f"range (0, {self._cfg.max_seconds_cap:.0f}]"
+                self._counters.inc("lint_rejects")
+                rules = sorted({f["rule"] for f in lint["findings"]})
+                first = lint["findings"][0]["message"] if lint["findings"] else (
+                    "; ".join(lint["errors"]) or "flight-check failed"
                 )
-            if (
-                self._cfg.max_states_cap is not None
-                and max_states is not None
-                and max_states > self._cfg.max_states_cap
-            ):
+                raise AdmissionError(
+                    f"admission flight-check failed for {spec!r} "
+                    f"({', '.join(rules) or 'trace error'}): {first}"
+                )
+            if budget_reason is not None:
                 self._counters.inc("rejected")
-                raise AdmissionError(
-                    f"max_states {max_states} exceeds the pool cap "
-                    f"{self._cfg.max_states_cap}"
-                )
+                raise AdmissionError(budget_reason)
             counts = self._counts()
-            if counts["queued"] + counts["quarantined"] >= self._cfg.max_queue:
+            if (
+                counts["queued"] + counts["quarantined"] >= self._cfg.max_queue
+                # The precheck saw a full queue and skipped the lint; if
+                # it drained in the (subprocess-free, microsecond) gap,
+                # still reject as queue-full rather than admit an
+                # UNLINTED job — the client's retry gets the real
+                # verdict.
+                or (queue_full and lint is None and self._cfg.admission_lint)
+            ):
                 self._counters.inc("rejected")
                 raise AdmissionError(
                     f"queue full ({self._cfg.max_queue} waiting jobs)",
@@ -397,6 +583,7 @@ class CheckerService:
                 max_states=max_states,
                 chaos=chaos,
             )
+            job.lint = lint
             job.dir = os.path.join(self._ensure_session_dir(), job.id)
             os.makedirs(job.dir, exist_ok=True)
             self._jobs[job.id] = job

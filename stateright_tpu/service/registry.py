@@ -97,6 +97,60 @@ FAMILIES: Dict[str, Callable[[List[int]], Tuple[Any, Dict[str, int]]]] = {
     "increment-lock": _increment_lock,
 }
 
+
+def _extra_family_targets() -> Dict[str, Tuple[str, str]]:
+    """The ``STPU_FAMILIES="name=module:attr,..."`` mapping, parsed but
+    NOT imported — :func:`parse` validates spec names against this
+    without executing any user code, so the (jax-free, wedge-proof)
+    service process can admission-validate a user spec while the import
+    itself happens only in the subprocesses that resolve it (the
+    admission-lint run, the job workers). A malformed entry raises
+    ``ValueError`` — a caller bug, same contract as an unknown spec."""
+    import os
+
+    raw = os.environ.get("STPU_FAMILIES", "").strip()
+    if not raw:
+        return {}
+    out: Dict[str, Tuple[str, str]] = {}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, target = entry.partition("=")
+        mod_name, colon, attr = target.partition(":")
+        if not (eq and colon and name.strip() and mod_name and attr):
+            raise ValueError(
+                f"malformed STPU_FAMILIES entry {entry!r} "
+                '(expected "name=module:attr")'
+            )
+        out[name.strip()] = (mod_name, attr)
+    return out
+
+
+def _load_extra_family(name: str) -> Callable[[List[int]], Tuple[Any, Dict[str, int]]]:
+    """Import ONE user family's factory (same ``(args) -> (model,
+    capacities)`` contract as the shipped ones). Only the requested
+    entry is imported — one broken STPU_FAMILIES entry must not take
+    down the healthy ones — and only :func:`resolve` reaches this:
+    importing a user module executes its top-level code, which must
+    never happen in the service pool process (it may import jax and
+    wedge on backend bring-up; see service/core.py). Kept OUT of
+    :data:`FAMILIES` on purpose: shipped families are the tree's
+    (content-hash-cacheable by the lint); user families are the
+    caller's, re-resolved lazily on every call so the env var works
+    across the process boundaries the service creates."""
+    import importlib
+
+    mod_name, attr = _extra_family_targets()[name]
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, attr)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(
+            f"STPU_FAMILIES entry {name}={mod_name}:{attr} "
+            f"failed to load: {e}"
+        ) from e
+
 #: The seven shipped packed-model configurations — the shapes
 #: ``tools/warm_cache.py`` pre-seeds the persistent XLA compile cache with
 #: so a fresh service's first request pays seconds, not minutes
@@ -117,7 +171,7 @@ def parse(spec: str) -> Tuple[str, List[int]]:
     an unknown family or malformed args (typed: admission control converts
     nothing — a bad spec is a caller bug, not a capacity problem)."""
     name, _, rest = spec.strip().partition(":")
-    if name not in FAMILIES:
+    if name not in FAMILIES and name not in _extra_family_targets():
         raise ValueError(
             f"unknown model spec {spec!r}; families: {sorted(FAMILIES)}"
         )
@@ -131,4 +185,5 @@ def parse(spec: str) -> Tuple[str, List[int]]:
 def resolve(spec: str) -> Tuple[Any, Dict[str, int]]:
     """Spec string -> ``(packed model, default spawn capacities)``."""
     name, args = parse(spec)
-    return FAMILIES[name](args)
+    factory = FAMILIES.get(name) or _load_extra_family(name)
+    return factory(args)

@@ -64,7 +64,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -126,6 +126,122 @@ def accel_auto_compaction(state_words: int) -> str:
     checks the sort widths the chip actually runs; a threshold change
     here re-aims both."""
     return "gather" if state_words > 8 else "sort"
+
+
+# --- the ladder/rung planner, as shared pure functions ----------------------
+#
+# The compile-shape schedule — which run buckets the ladder can land on,
+# how big each bucket's candidate buffer starts, and which sub-width rungs
+# a fused program specialises — used to live only inside XlaChecker
+# methods, readable by nothing but a live checker. These module-level
+# functions are the ONE definition: the engine delegates to them
+# (``_run_cap_for`` / ``_default_cand_cap`` / ``_cand_rungs``), and
+# stpu-lint's compile-plan census (``analysis/census.py``, STPU007)
+# enumerates them statically, the same way ``accel_auto_compaction``
+# already re-aims both the engine and STPU003. A planner change here
+# re-aims the census, the warm-cache set, and the engine together.
+
+#: The bucket ladder's floor (see ``_run_cap_for``'s docstring: the
+#: round-3 deep-narrow finding — ABD never widens past 54 rows, so a
+#: 1024-row floor paid a ~1000x action-grid padding tax per level).
+RUN_BUCKET_FLOOR = 64
+
+#: In-program candidate-ladder rung floor: sub-widths below this gain
+#: nothing (buckets <= 256 run full-grid candidate buffers and their
+#: sorts are batch-trivial) while every rung is a full superstep traced
+#: into the fused program — compile cost, not savings.
+CAND_RUNG_FLOOR = 256
+
+#: The in-program candidate-ladder depth "auto" resolves to on the
+#: planes engine (``XlaChecker.__init__``; the rows/hash engine has no
+#: candidate-scale sorts to snug and stays at 1).
+CAND_LADDER_AUTO_K = 3
+
+
+def auto_dedup(backend: str) -> str:
+    """The visited-set structure "auto" resolves to per backend (the
+    round-5 cost model: scatter-election hash insert is the TPU
+    bottleneck, sort-merge wins there; hash + scatter wins on CPU).
+    Shared with the census so the warm set prices the structure the
+    engine will actually run."""
+    return "hash" if backend == "cpu" else "sorted"
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def ladder_buckets(frontier_capacity: int) -> List[int]:
+    """Every run bucket the ladder can land on under a frontier-capacity
+    ceiling: powers of four from ``RUN_BUCKET_FLOOR``, with the ceiling
+    itself as the (possibly non-power-of-four) top rung — exactly the
+    values ``_run_cap_for``/``_grow_frontier`` can return before a
+    growth event doubles the ceiling. Each distinct bucket is a separate
+    XLA compilation; ``len(ladder_buckets(F))`` is therefore the
+    compile-shape count a run plan commits to (the STPU007 budget's
+    subject)."""
+    out = [min(RUN_BUCKET_FLOOR, frontier_capacity)]
+    while out[-1] < frontier_capacity:
+        out.append(min(out[-1] * 4, frontier_capacity))
+    return out
+
+
+def default_cand_cap(
+    run_cap: int,
+    max_actions: int,
+    backend: str,
+    env: Optional[Dict[str, str]] = None,
+) -> int:
+    """The candidate-buffer capacity a so-far-unseen bucket starts at
+    (before any cc_ovf growth): the full action grid for small buckets,
+    a power-of-two fraction of it above (CPU m/4, accelerators m/16 —
+    per-level cost there scales with sorted lane-words, round-5
+    profile). ``env`` defaults to ``os.environ`` (the STPU_CAND_FRAC A/B
+    knob); pass ``{}`` for the hermetic census."""
+    e = os.environ if env is None else env
+    m = run_cap * max_actions
+    if run_cap <= 256:
+        # Small buckets take the FULL grid: compaction saves nothing at
+        # this scale, and an undersized buffer costs a cc_ovf -> grow ->
+        # fresh-XLA-compile round per growth.
+        cap = _next_pow2(m)
+    else:
+        den = int(e.get("STPU_CAND_FRAC", "4" if backend == "cpu" else "16"))
+        cap = max(1024, _next_pow2(max(m // den, 1)))
+    return min(cap, _next_pow2(m))
+
+
+def cand_rungs(
+    f_cap: int,
+    cand_cap_of: Callable[[int], int],
+    k: int,
+    floor: int = CAND_RUNG_FLOOR,
+) -> List[Tuple[int, int]]:
+    """The in-program candidate ladder for a fused dispatch at bucket
+    ``f_cap``: ascending ``[(F_k, C_k)]`` sub-width shapes, last = the
+    full bucket. ``cand_cap_of`` maps a bucket to its candidate cap (a
+    live checker passes its learned-cap lookup; the census passes
+    :func:`default_cand_cap`)."""
+    full = (f_cap, cand_cap_of(f_cap))
+    if k <= 1:
+        return [full]
+    rungs = [full]
+    Fk = f_cap
+    while len(rungs) < k:
+        Fk //= 4
+        if Fk < floor:
+            break
+        # Monotone envelope: a cc_ovf growth at a SMALL bucket (its own
+        # host dispatches) can push that bucket's learned cap past a
+        # bigger bucket's — unclamped, the "snug" rung would then sort a
+        # WIDER candidate buffer than the branch above it, inverting the
+        # ladder's savings while the telemetry reports the inflated cap
+        # as snug. Clamp each rung to the next rung up; an undersized
+        # clamp only costs the in-program fall-through, never a dropped
+        # candidate.
+        rungs.append((Fk, min(cand_cap_of(Fk), rungs[-1][1])))
+    rungs.reverse()
+    return rungs
 
 
 def capacity_hints(model: Model) -> Dict[str, int]:
@@ -239,7 +355,7 @@ class XlaChecker(Checker):
             dedup = (
                 "sorted"
                 if requested_compaction in ("bsearch", "pallas")
-                else "hash" if jax.default_backend() == "cpu" else "sorted"
+                else auto_dedup(jax.default_backend())
             )
         if dedup not in ("hash", "sorted", "delta"):
             raise ValueError(
@@ -364,7 +480,9 @@ class XlaChecker(Checker):
         explicit_cand_ladder = cand_ladder != "auto"
         env_cand_ladder = bool(os.environ.get("STPU_CAND_LADDER"))
         if cand_ladder == "auto":
-            cand_ladder = os.environ.get("STPU_CAND_LADDER") or "3"
+            cand_ladder = os.environ.get("STPU_CAND_LADDER") or str(
+                CAND_LADDER_AUTO_K
+            )
         try:
             ladder_k = int(cand_ladder)
         except (TypeError, ValueError):
@@ -1644,39 +1762,18 @@ class XlaChecker(Checker):
         """The cap :meth:`_cand_cap_for` would size a so-far-unseen bucket
         at — split out non-mutating so the sibling eviction guard in
         :meth:`_grow_cand_cap` can probe another live checker's would-be
-        sizing without inserting entries into its cap dict."""
-        m = run_cap * self._A
-        if run_cap <= 256:
-            # Small buckets take the FULL grid: compaction saves
-            # nothing at this scale, and an undersized buffer costs a
-            # cc_ovf -> grow -> fresh-XLA-compile round per growth —
-            # the dominant warm-pass term for ramping spaces once the
-            # bucket ladder starts at 64.
-            cap = self._next_pow2(m)
-        else:
-            # Power-of-two (not four): a pow4 ladder can land just
-            # above the target at the big buckets and erase most of
-            # the compaction win. The initial fraction is a guess the
-            # cc_ovf protocol self-corrects (warm pass pays the grow
-            # compiles; the measured pass replays learned caps): CPU
-            # keeps the round-2 m/4; accelerators start at m/16 —
-            # per-level cost there scales with sorted lane-words
-            # x log2^2(n) (round-5 profile), so a snugger candidate
-            # buffer directly shrinks the insert's merge sort (rm=8
-            # real peak validity is ~11% of the grid). STPU_CAND_FRAC
-            # overrides the denominator for A/Bs.
-            import jax as _jax
-
-            den = int(os.environ.get(
-                "STPU_CAND_FRAC",
-                "4" if _jax.default_backend() == "cpu" else "16",
-            ))
-            cap = max(1024, self._next_pow2(max(m // den, 1)))
-        return min(cap, self._next_pow2(m))
+        sizing without inserting entries into its cap dict. The sizing
+        policy itself (full grid small, power-of-two fraction big,
+        STPU_CAND_FRAC A/B) is the shared module-level
+        :func:`default_cand_cap` so the compile-plan census enumerates
+        the caps the engine actually starts at."""
+        return default_cand_cap(
+            run_cap, self._A, self._jax.default_backend()
+        )
 
     @staticmethod
     def _next_pow2(n: int) -> int:
-        return 1 << max(n - 1, 1).bit_length()
+        return _next_pow2(n)
 
     def _grow_cand_cap(self, run_cap: int) -> None:
         self._counters.inc("cand_grows")
@@ -1731,11 +1828,10 @@ class XlaChecker(Checker):
         live[:] = [r for r in live if r() is not None]
         return [c for r in live if (c := r()) is not None and c is not self]
 
-    #: In-program candidate-ladder rung floor: sub-widths below this gain
-    #: nothing (buckets <= 256 run full-grid candidate buffers and their
-    #: sorts are batch-trivial) while every rung is a full superstep
-    #: traced into the fused program — compile cost, not savings.
-    CAND_RUNG_FLOOR = 256
+    #: In-program candidate-ladder rung floor (the shared planner's
+    #: constant, re-exported on the class for the A/B harnesses that
+    #: already read it here).
+    CAND_RUNG_FLOOR = CAND_RUNG_FLOOR
     #: Headroom multiplier on the device-side candidate estimate. An
     #: underestimate costs one wasted snug superstep (the in-program
     #: fall-through re-runs the level full-width), so the estimate is
@@ -1751,26 +1847,8 @@ class XlaChecker(Checker):
         peak program — so a branch's committed level is bit-identical to
         what a host re-dispatch at that bucket would have produced,
         without the re-dispatch."""
-        full = (f_cap, self._cand_cap_for(f_cap))
-        if self._cand_ladder_k <= 1 or not self._soa:
-            return [full]
-        rungs = [full]
-        Fk = f_cap
-        while len(rungs) < self._cand_ladder_k:
-            Fk //= 4
-            if Fk < self.CAND_RUNG_FLOOR:
-                break
-            # Monotone envelope: a cc_ovf growth at a SMALL bucket (its
-            # own host dispatches) can push that bucket's learned cap
-            # past a bigger bucket's — unclamped, the "snug" rung would
-            # then sort a WIDER candidate buffer than the branch above
-            # it, inverting the ladder's savings while the telemetry
-            # reports the inflated cap as snug. Clamp each rung to the
-            # next rung up; an undersized clamp only costs the
-            # in-program fall-through, never a dropped candidate.
-            rungs.append((Fk, min(self._cand_cap_for(Fk), rungs[-1][1])))
-        rungs.reverse()
-        return rungs
+        k = self._cand_ladder_k if self._soa else 1
+        return cand_rungs(f_cap, self._cand_cap_for, k)
 
     def _level_lane_words(self, bucket: int, cand_w: int) -> int:
         """32-bit words carried through ``lax.sort`` operands by ONE
@@ -2011,16 +2089,17 @@ class XlaChecker(Checker):
         buckets instead of 8."""
         self._counters.inc("frontier_grows")
         if run_cap < self._frontier_capacity:
-            ramp = min(run_cap * 4, self._frontier_capacity)
+            buckets = ladder_buckets(self._frontier_capacity)
+            ramp = next(b for b in buckets if b > run_cap)
             nxt = ramp
             if self._ladder == "jump":
                 g = self._recent_growth()
                 if g is not None and g >= 2.0:
                     est_peak = run_cap * min(g, self.LADDER_GROWTH_CLAMP) ** 2
-                    jump = 64
-                    while jump < 4 * est_peak:
-                        jump *= 4
-                    nxt = min(max(nxt, jump), self._frontier_capacity)
+                    jump = next(
+                        (b for b in buckets if b >= 4 * est_peak), buckets[-1]
+                    )
+                    nxt = max(nxt, jump)
             if nxt > ramp:
                 self._counters.inc("ladder_jumps")
             return nxt
@@ -2046,11 +2125,9 @@ class XlaChecker(Checker):
         ``LADDER_REUSE_BOUND`` of the snug one is preferred: re-entering
         mid-space (bench measured pass, target-bounded runs) must ride
         the warm pass's compilations, not pay fresh ones."""
-        want = max(4 * max(n, 1), 64)
-        cap = 64
-        while cap < want:
-            cap *= 4
-        cap = min(cap, self._frontier_capacity)
+        want = max(4 * max(n, 1), RUN_BUCKET_FLOOR)
+        buckets = ladder_buckets(self._frontier_capacity)
+        cap = next((b for b in buckets if b >= want), buckets[-1])
         if self._ladder == "jump":
             reusable = [
                 c

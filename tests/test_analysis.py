@@ -98,9 +98,29 @@ def test_stpu002_flags_out_axes_transpose():
     hits = output_transposes(jx, "golden:stpu002")
     assert [f.rule for f in hits] == ["STPU002"]
     assert "transpose" in hits[0].excerpt
+    assert "out_axes != 0" in hits[0].message  # the direct-output form
 
     clean = jax.make_jaxpr(jax.vmap(kernel))(_sds((64, 4), jnp.uint32))
     assert output_transposes(clean, "golden:rows") == []
+
+
+def test_stpu002_flags_mid_kernel_transpose():
+    """The documented gap, closed: a transpose buried BETWEEN ops (here
+    a nested vmap(out_axes=1) whose transpose feeds a further add, so it
+    does not produce the surface's outputs directly) is still the
+    transpose-fused-into-vmap shape XLA:CPU miscompiles."""
+
+    def inner(col):
+        return col + jnp.uint32(1)
+
+    def kernel(words):  # words [4, 4]
+        cols = jax.vmap(inner, out_axes=1)(words)  # transpose, mid-kernel
+        return cols + jnp.uint32(1)  # ...consumed by a further op
+
+    jx = jax.make_jaxpr(jax.vmap(kernel))(_sds((64, 4, 4), jnp.uint32))
+    hits = output_transposes(jx, "golden:mid-kernel")
+    assert hits and all(f.rule == "STPU002" for f in hits)
+    assert any("mid-kernel" in f.message for f in hits)
 
 
 # --- STPU003: the wide-W sort compile-stall shape ---------------------------
@@ -190,9 +210,159 @@ def test_stpu005_shipped_kernels_preflight_for_tpu():
     the TPU target from this CPU-only process (this is the check that
     caught the integer-reduction Mosaic gap in both kernels)."""
     reports = {r.name: r for r in run_sweep(only=["pallas:"])}
-    assert set(reports) == {"pallas:compact", "pallas:merge"}
+    assert {"pallas:compact", "pallas:merge"} <= set(reports)
     for rep in reports.values():
         assert rep.error == "", rep.error
+        assert rep.findings == [], [f.message for f in rep.findings]
+
+
+# --- STPU006: static VMEM budget for pallas kernels -------------------------
+
+
+def test_stpu006_flags_oversized_vmem_kernel():
+    """A kernel whose scratch ring alone blows the ~16 MiB v5e budget —
+    today this shape is a runtime Mosaic allocation error discovered ON
+    CHIP; the flight-check prices it statically."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from stateright_tpu.analysis.jaxpr_lint import vmem_budget
+
+    def kernel(x_ref, o_ref, big_scratch):
+        o_ref[...] = x_ref[...]
+
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((256,), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((4096, 4096), jnp.float32)],  # 64 MiB
+        )(x)
+
+    jx = jax.make_jaxpr(run)(_sds((256,), jnp.float32))
+    hits = vmem_budget(jx, "golden:stpu006")
+    assert [f.rule for f in hits] == ["STPU006"]
+    assert "VMEM footprint" in hits[0].message
+    assert "scratch" in hits[0].message
+
+
+def test_stpu006_shipped_kernels_fit_across_block_range():
+    """Both shipped kernels price under the budget at every supported
+    STPU_PALLAS_BLOCK (the per-block surfaces in the sweep)."""
+    reports = {r.name: r for r in run_sweep(only=["pallas:vmem:"])}
+    assert reports, "per-block vmem surfaces missing from the sweep"
+    for rep in reports.values():
+        assert rep.error == "", rep.error
+        assert rep.findings == [], [f.message for f in rep.findings]
+
+
+# --- STPU007: the compile-plan census ----------------------------------------
+
+
+def test_stpu007_flags_over_budget_plan():
+    from stateright_tpu.analysis.census import census_findings, plan_for
+
+    plan = plan_for("2pc:3", "tpu", frontier_capacity=1 << 22)
+    census = {"specs": {"2pc:3": {"tpu": plan}}}
+    hits = census_findings(census)
+    assert [f.rule for f in hits] == ["STPU007"]
+    assert f"{plan['distinct_programs']} distinct" in hits[0].message
+    assert plan["distinct_programs"] > plan["budget"]
+
+
+def test_census_matches_shipped_and_planner():
+    """The census is the SHIPPED registry run through the shared ladder
+    planner — drift in either direction is a failure — and the warm set
+    tools/warm_cache.py derives equals it exactly."""
+    import importlib.util
+
+    from stateright_tpu.analysis.census import build_census, census_findings, warm_specs
+    from stateright_tpu.service.registry import SHIPPED, resolve
+    from stateright_tpu.xla import default_cand_cap, ladder_buckets
+
+    census = build_census()
+    assert list(census["specs"]) == list(SHIPPED)
+    assert census_findings(census) == []  # every shipped plan in budget
+    assert warm_specs(census) == list(SHIPPED)
+
+    # The census's shapes are the shared planner's, at the registry
+    # capacities (spot-check one spec end to end).
+    model, caps = resolve("paxos:2,3")
+    plan = census["specs"]["paxos:2,3"]["tpu"]
+    buckets = ladder_buckets(caps["frontier_capacity"])
+    assert [s["bucket"] for s in plan["shapes"]] == buckets
+    assert plan["shapes"][-1]["cand_cap"] == default_cand_cap(
+        buckets[-1], model.max_actions, "tpu", env={}
+    )
+
+    # tools/warm_cache.py's default --specs goes through the same
+    # derivation (the warm set is derived, not hand-maintained).
+    spec = importlib.util.spec_from_file_location(
+        "warm_cache", os.path.join(os.path.dirname(__file__), "..", "tools", "warm_cache.py")
+    )
+    wc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wc)
+    assert wc.default_specs() == list(SHIPPED)
+
+
+# --- STPU008: cross-backend lowering diff ------------------------------------
+
+
+def test_stpu008_flags_one_sided_pathology_op():
+    from stateright_tpu.analysis.jaxpr_lint import diff_lowering_inventories
+
+    base = {"stablehlo.add", "stablehlo.compare", "stablehlo.iota"}
+    hits = diff_lowering_inventories(
+        "golden:stpu008",
+        base | {"stablehlo.scatter"},  # cpu lowers a scatter...
+        base,  # ...tpu lowers none — the dropped-write class
+    )
+    assert [f.rule for f in hits] == ["STPU008"]
+    assert "stablehlo.scatter" in hits[0].message
+    assert "cpu" in hits[0].excerpt
+
+    # Symmetric inventories — even with pathology ops on BOTH sides —
+    # are clean: the rule is about divergence, not presence (STPU001/003
+    # own presence).
+    both = base | {"stablehlo.sort"}
+    assert diff_lowering_inventories("golden:same", both, both) == []
+    # A non-registry op on one side only is noise, not a finding.
+    assert (
+        diff_lowering_inventories("golden:benign", base | {"stablehlo.tanh"}, base)
+        == []
+    )
+
+
+def test_stpu008_shipped_kernels_lower_identically():
+    """Both width classes' transition kernels produce identical
+    pathology-op inventories on cpu and tpu lowerings (the integration
+    form; the sweep runs this surface by default)."""
+    reports = {r.name: r for r in run_sweep(only=["lower:2pc:3"])}
+    assert set(reports) == {"lower:2pc:3:packed_step"}
+    rep = reports["lower:2pc:3:packed_step"]
+    assert rep.error == "", rep.error
+    assert rep.findings == [], [f.message for f in rep.findings]
+
+
+# --- the sharded mesh engine is a traced surface -----------------------------
+
+
+def test_sharded_superstep_is_a_registered_surface():
+    """The second documented missing surface, closed: the mesh engine's
+    shard_map superstep traces under the 8-device virtual CPU mesh (the
+    config tests/conftest.py forces) in both dedup configs."""
+    import jax as _jax
+
+    reports = {r.name: r for r in run_sweep(only=["sharded-superstep"])}
+    assert set(reports) == {
+        "engine:2pc:3:sharded-superstep:hash",
+        "engine:2pc:3:sharded-superstep:sorted",
+    }
+    for rep in reports.values():
+        if len(_jax.devices()) < 8:  # pragma: no cover - conftest forces 8
+            assert rep.skipped
+            continue
+        assert rep.error == "", rep.error
+        assert rep.skipped == ""
         assert rep.findings == [], [f.message for f in rep.findings]
 
 
@@ -304,6 +474,68 @@ def test_waiver_round_trip(tmp_path):
     assert [w.rule for w in unused] == ["STPU003"]  # stale, reported
 
 
+def test_waiver_expiry_stops_suppressing(tmp_path):
+    """An expired waiver is reported like a stale one and its findings
+    go ACTIVE — chip-A/B-pending waivers cannot rot past their window."""
+    f = Finding(
+        rule="STPU001", surface="ops:hashset-insert",
+        file="stateright_tpu/ops/hashset.py", line=5, message="m", excerpt="e",
+    )
+    wpath = tmp_path / "w.toml"
+    wpath.write_text(
+        "[[waiver]]\n"
+        'rule = "STPU001"\n'
+        'surface = "ops:hashset-insert"\n'
+        'reason = "pending chip A/B"\n'
+        'expires = "2026-01-01"\n'  # past (today is later)
+    )
+    waivers = load_waivers(str(wpath))
+    assert waivers[0].expired
+    active, waived, unused = apply_waivers([f], waivers)
+    assert [x.surface for x in active] == ["ops:hashset-insert"]
+    assert waived == []
+    assert unused == waivers  # reported like stale
+
+    # A future expiry still suppresses.
+    wpath.write_text(
+        "[[waiver]]\n"
+        'rule = "STPU001"\n'
+        'surface = "ops:hashset-insert"\n'
+        'reason = "pending chip A/B"\n'
+        'expires = "2099-01-01"\n'
+    )
+    f2 = Finding(
+        rule="STPU001", surface="ops:hashset-insert",
+        file="stateright_tpu/ops/hashset.py", line=5, message="m", excerpt="e",
+    )
+    active, waived, unused = apply_waivers([f2], load_waivers(str(wpath)))
+    assert active == [] and len(waived) == 1 and unused == []
+
+    # Garbage dates are loud, not silently never-expiring.
+    wpath.write_text(
+        '[[waiver]]\nrule = "STPU001"\nreason = "x"\nexpires = "soonish"\n'
+    )
+    with pytest.raises(WaiverError, match="YYYY-MM-DD"):
+        load_waivers(str(wpath))
+
+
+def test_expired_waiver_reported_in_cli_report(tmp_path):
+    """run_lint marks the expired entry even on a partial run (unlike
+    merely-stale waivers, an expired one is actionable on ANY run)."""
+    wpath = tmp_path / "w.toml"
+    wpath.write_text(
+        "[[waiver]]\n"
+        'rule = "STPU003"\n'
+        'reason = "pending chip A/B"\n'
+        'expires = "2026-01-01"\n'
+    )
+    report = run_lint(trace=False, ast_pass=True, waivers_path=str(wpath))
+    assert report["partial"] is True  # AST-only run
+    expired = [w for w in report["unused_waivers"] if w["expired"]]
+    assert [w["rule"] for w in expired] == ["STPU003"]
+    assert expired[0]["expires"] == "2026-01-01"
+
+
 def test_waiver_file_is_loud_on_garbage(tmp_path):
     bad = tmp_path / "w.toml"
     bad.write_text("[[waiver]]\nrule = STPU001\n")  # unquoted value
@@ -354,3 +586,162 @@ def test_full_lint_clean():
     assert report["errors"] == []
     assert report["findings"] == [], report["findings"]
     assert report["unused_waivers"] == [], report["unused_waivers"]
+
+
+# --- CLI exit-code-2 paths and the partial contract --------------------------
+
+
+def test_cli_exit_2_on_malformed_waiver_file(tmp_path, capsys):
+    from stateright_tpu.analysis.cli import main
+
+    bad = tmp_path / "w.toml"
+    bad.write_text("[[waiver]]\nrule = STPU001\n")  # unquoted value
+    rc = main(["--no-trace", "--waivers", str(bad)])
+    assert rc == 2
+    assert "waiver file error" in capsys.readouterr().err
+
+
+def test_cli_exit_2_on_surface_trace_failure(monkeypatch, tmp_path):
+    """A surface that cannot be TRACED is exit 2 (not verified), never a
+    silent pass — and the report's errors list names it."""
+    from stateright_tpu.analysis import surfaces
+    from stateright_tpu.analysis.cli import main
+
+    def boom():
+        raise RuntimeError("golden trace failure")
+
+    monkeypatch.setattr(
+        surfaces, "build_sweep", lambda full=False: [("golden:boom", boom)]
+    )
+    out = tmp_path / "lint.json"
+    rc = main(["--no-ast", "--no-cache", "--json-out", str(out)])
+    assert rc == 2
+    import json as _json
+
+    report = _json.loads(out.read_text())
+    assert report["ok"] is False
+    assert report["errors"] == ["golden:boom: RuntimeError: golden trace failure"]
+    assert report["surfaces"][0]["error"].startswith("RuntimeError")
+
+
+def test_cli_exit_2_on_unknown_admission_spec(capsys):
+    from stateright_tpu.analysis.cli import main
+
+    rc = main(["--admission", "nosuchfamily:3", "--no-cache"])
+    assert rc == 2
+    assert "unknown model spec" in capsys.readouterr().err
+
+
+def test_partial_contract_for_lint_ok_provenance(tmp_path, monkeypatch):
+    """The contract bench.py's lint_ok tri-state relies on: every
+    filtered run is marked partial, and bench treats a partial artifact
+    as None (not a pass, not a fail)."""
+    report = run_lint(trace=False, ast_pass=True)
+    assert report["partial"] is True
+    report = run_lint(
+        trace=True, ast_pass=False, only=["plan:shipped"], use_cache=False
+    )
+    assert report["partial"] is True
+    report = run_lint(trace=False, ast_pass=True, rules=["STPU101"])
+    assert report["partial"] is True
+
+    import bench
+
+    runs = tmp_path / "runs"
+    runs.mkdir()
+    # A partial artifact -> None, even when fresh and ok.
+    (runs / "lint.json").write_text('{"ok": true, "partial": true}')
+    monkeypatch.setattr(bench, "RUNS", str(runs))
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))  # no newer sources
+    assert bench._lint_ok() is None
+    # A full artifact -> its verdict.
+    (runs / "lint.json").write_text('{"ok": true, "partial": false}')
+    assert bench._lint_ok() is True
+    (runs / "lint.json").write_text('{"ok": false, "partial": false}')
+    assert bench._lint_ok() is False
+    # Missing artifact -> None.
+    (runs / "lint.json").unlink()
+    assert bench._lint_ok() is None
+
+
+def test_compile_plan_provenance_reads_census(tmp_path, monkeypatch):
+    import bench
+
+    runs = tmp_path / "runs"
+    runs.mkdir()
+    monkeypatch.setattr(bench, "RUNS", str(runs))
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    assert bench._compile_plan() is None  # no artifact
+    (runs / "compile_plan.json").write_text(
+        '{"tree": "abc", "specs": {"2pc:3": {"tpu": '
+        '{"distinct_programs": 3}}}}'
+    )
+    plan = bench._compile_plan()
+    assert plan == {
+        "tree": "abc",
+        "distinct_programs": {"2pc:3": {"tpu": 3}},
+    }
+
+
+# --- the content-hash surface cache ------------------------------------------
+
+
+def test_surface_cache_round_trip(tmp_path):
+    """Second run replays findings from the cache (cached=True, same
+    findings); --no-cache forces a fresh trace; errors are not cached."""
+    from stateright_tpu.analysis.surfaces import run_sweep as sweep
+
+    cold = sweep(only=["plan:shipped"], cache_dir=str(tmp_path))
+    assert [r.cached for r in cold] == [False]
+    warm = sweep(only=["plan:shipped"], cache_dir=str(tmp_path))
+    assert [r.cached for r in warm] == [True]
+    assert [f.to_json() for f in warm[0].findings] == [
+        f.to_json() for f in cold[0].findings
+    ]
+    fresh = sweep(only=["plan:shipped"], cache_dir=str(tmp_path), use_cache=False)
+    assert [r.cached for r in fresh] == [False]
+
+
+def test_surface_cache_invalidates_on_tree_change(tmp_path, monkeypatch):
+    from stateright_tpu.analysis import cache as cache_mod
+
+    c1 = cache_mod.SurfaceCache(str(tmp_path))
+    f = Finding(rule="STPU003", surface="s", file="f.py", line=1,
+                message="m", excerpt="e")
+    c1.put("s", [f])
+    assert [x.message for x in c1.get("s")] == ["m"]
+    # A different tree hash misses (and prunes the old tree's entries on
+    # its first write).
+    monkeypatch.setattr(cache_mod, "_tree_hash_memo", "f" * 64)
+    c2 = cache_mod.SurfaceCache(str(tmp_path))
+    assert c2.get("s") is None
+    c2.put("s", [])
+    assert sorted(os.listdir(tmp_path)) == ["f" * 12]
+
+
+# --- SARIF output ------------------------------------------------------------
+
+
+def test_sarif_output(tmp_path):
+    import json as _json
+
+    from stateright_tpu.analysis.cli import write_sarif
+
+    report = run_lint(trace=False, ast_pass=True)
+    path = tmp_path / "lint.sarif"
+    write_sarif(report, str(path))
+    sarif = _json.loads(path.read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "stpu-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"STPU001", "STPU006", "STPU007", "STPU008"} <= rule_ids
+    # The shipped tree's waived findings ride as notes with locations.
+    notes = [r for r in run["results"] if r["level"] == "note"]
+    assert notes, "expected the waived AST findings as SARIF notes"
+    assert all(r["ruleId"] in rule_ids for r in run["results"])
+    located = [r for r in run["results"] if "locations" in r]
+    assert located and all(
+        r["locations"][0]["physicalLocation"]["region"]["startLine"] >= 1
+        for r in located
+    )

@@ -55,6 +55,11 @@ def _config(tmp_path, **kw):
         poll_s=0.2,
         backoff_s=0.1,
         probe_auto=False,
+        # The admission flight-check is pinned by its own tests below;
+        # the chaos/breaker pins disable it so each test pays for
+        # exactly the machinery it pins (a cold lint subprocess costs
+        # ~20 s of jax import + traces on this 1-core box).
+        admission_lint=False,
     )
     base.update(kw)
     return ServiceConfig(**base)
@@ -122,6 +127,110 @@ def test_admission_rejection_at_caps(tmp_path):
         assert g["queued"] == 2
         assert g["rejected"] == 2
         assert g["admitted"] == 2
+    finally:
+        svc.close()
+
+
+# --- admission flight-check (stpu-lint --admission at submit) ---------------
+
+_EVIL_FAMILY = '''
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+
+class EvilTwoPhase(PackedTwoPhaseSys):
+    """The round-3/5 paxos-drift shape, resubmitted as a user model: a
+    traced-index .at[] write in the transition kernel (STPU001)."""
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+
+        nxt, valid = super().packed_step(words)
+        i = words[0] & jnp.uint32(1)
+        nxt = nxt.at[0, i].set(nxt[0, 0])
+        return nxt, valid
+
+
+def evil(args):
+    rm = args[0] if args else 3
+    return EvilTwoPhase(rm), dict(
+        frontier_capacity=1 << 10, table_capacity=1 << 13
+    )
+'''
+
+
+def test_admission_lint_rejects_unwaived_finding(tmp_path, monkeypatch):
+    """The gate user-submitted specs (STPU_FAMILIES) pass through: a
+    model whose kernel carries a pinned-fatal shape is rejected at
+    submit with a typed AdmissionError naming the rule — before the
+    pool ever schedules it on the device — while a shipped spec admits
+    with its verdict recorded in the job snapshot (and so /.pool)."""
+    (tmp_path / "evil_family_mod.py").write_text(_EVIL_FAMILY)
+    # In-process (registry.parse at submit) and subprocess (the lint and
+    # any worker) both resolve the family: sys.path for the former,
+    # PYTHONPATH for the latter.
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("PYTHONPATH", str(tmp_path))
+    monkeypatch.setenv("STPU_FAMILIES", "evil=evil_family_mod:evil")
+    svc = CheckerService(_config(tmp_path, admission_lint=True))
+    svc._ensure_scheduler = lambda: None  # admission accounting only
+    try:
+        with pytest.raises(AdmissionError) as exc:
+            svc.submit("evil:3")
+        assert "STPU001" in str(exc.value)
+        assert exc.value.retry_after_s is None  # retrying cannot help
+        assert "flight-check" in exc.value.reason
+
+        # User-family verdicts are NEVER memoized (their source lives
+        # outside the tree hash): a user who FIXES the model and
+        # resubmits to the same pool gets a fresh verdict and admits.
+        (tmp_path / "evil_family_mod.py").write_text(
+            _EVIL_FAMILY.replace(
+                "nxt = nxt.at[0, i].set(nxt[0, 0])\n        ", ""
+            )
+        )
+        fixed = svc.submit("evil:3")
+        assert fixed.lint["ok"] is True and fixed.lint["cached"] is False
+
+        # A user family whose module cannot even LOAD is a spec defect,
+        # not a tooling failure: rejected (never fail-open admitted).
+        monkeypatch.setenv(
+            "STPU_FAMILIES",
+            "evil=evil_family_mod:evil,ghost=no_such_module_xyz:f",
+        )
+        with pytest.raises(AdmissionError, match="flight-check"):
+            svc.submit("ghost:1")
+
+        job = svc.submit("2pc:3")  # a shipped spec admits
+        assert job.lint is not None and job.lint["ok"] is True
+        assert job.snapshot()["lint"]["ok"] is True
+        # The per-service memo: resubmission pays no second subprocess.
+        assert svc.submit("2pc:3").lint["cached"] is True
+
+        g = svc.gauges()
+        # evil (rejected) + evil (fixed, unmemoized rerun) + ghost
+        # (rejected) + 2pc:3; the second 2pc:3 submit hit the memo.
+        assert g["lint_checks"] == 4
+        assert g["lint_rejects"] == 2
+        assert g["lint_errors"] == 0
+        assert g["rejected"] == 2 and g["admitted"] == 3
+    finally:
+        svc.close()
+
+
+def test_admission_lint_fails_open_on_tooling_error(tmp_path, monkeypatch):
+    """A broken lint TOOL (not a finding) must not take the pool down:
+    the job admits with ok=None recorded and lint_errors counted — an
+    operator sees a blind gate, tenants keep their fault isolation."""
+    from stateright_tpu.service import core as svc_core
+
+    monkeypatch.setattr(svc_core, "_LINT", "/nonexistent/stpu_lint.py")
+    svc = CheckerService(_config(tmp_path, admission_lint=True))
+    svc._ensure_scheduler = lambda: None
+    try:
+        job = svc.submit("2pc:3")
+        assert job.lint["ok"] is None
+        assert job.lint["errors"]
+        assert svc.gauges()["lint_errors"] == 1
     finally:
         svc.close()
 

@@ -11,9 +11,16 @@ match and every program lands in ``.jax_cache/``) once to completion
 through the REAL service worker, each under its own supervised process
 group — a wedge mid-warm burns one spec's budget, never the tool.
 
+The warm set is DERIVED from the STPU007 compile-plan census
+(``stateright_tpu/analysis/census.py`` — the same shared ladder planner
+the engine runs), not hand-maintained: the census enumerates each
+shipped spec's (bucket, cand-rung) schedule at the registry capacities,
+so a registry or planner change re-aims this tool automatically
+(census/SHIPPED drift is a test failure, ``tests/test_analysis.py``).
+
 Usage::
 
-    python tools/warm_cache.py                 # all seven shipped specs
+    python tools/warm_cache.py                 # the censused shipped specs
     python tools/warm_cache.py --specs 2pc:4 paxos:2,3
     python tools/warm_cache.py --platform cpu  # warm the CPU cache (CI)
 
@@ -34,14 +41,55 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from stateright_tpu import supervise as sup  # noqa: E402 (path bootstrap)
-from stateright_tpu.service.registry import SHIPPED, parse  # noqa: E402
+from stateright_tpu.service.registry import parse  # noqa: E402
 
 WORKER = os.path.join(REPO, "stateright_tpu", "service", "worker.py")
 
 
+def default_specs():
+    """The warm set, derived from the compile-plan census. The banked
+    artifact (``runs/compile_plan.json``, written by every full
+    stpu-lint run) is preferred — no jax import in this parent at all;
+    only when it is absent does the parent build the census in-process,
+    CPU-pinned first (the first jax backend use here must never be the
+    axon plugin — CLAUDE.md gotcha #1; the workers pick their own
+    platform via ``--platform``). The analyzer's pin appends the
+    8-virtual-device XLA flag for its mesh surface; that is restored
+    afterwards so warm WORKERS never inherit it."""
+    try:
+        with open(os.path.join(REPO, "runs", "compile_plan.json")) as fh:
+            census = json.load(fh)
+        # Freshness via the census's banked tree hash (tree_hash is pure
+        # file hashing — no jax): a census banked for some OTHER tree
+        # (e.g. before a spec joined SHIPPED) must not shape the warm
+        # set — that is exactly the drift the derivation eliminates.
+        from stateright_tpu.analysis.cache import tree_hash
+
+        specs = list(census["specs"])
+        if specs and census.get("tree") == tree_hash()[:12]:
+            return specs
+    except (OSError, json.JSONDecodeError, KeyError):
+        pass
+    flags = os.environ.get("XLA_FLAGS")
+    from stateright_tpu.analysis.census import warm_specs
+    from stateright_tpu.analysis.surfaces import pin_cpu
+
+    pin_cpu()
+    try:
+        return warm_specs()
+    finally:
+        if flags is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = flags
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--specs", nargs="*", default=list(SHIPPED))
+    p.add_argument(
+        "--specs", nargs="*", default=None,
+        help="default: derived from the STPU007 compile-plan census",
+    )
     p.add_argument("--platform", default="default",
                    help='"default" (accelerator) or "cpu"')
     p.add_argument("--budget-s", type=float, default=900.0,
@@ -52,6 +100,8 @@ def main() -> int:
     p.add_argument("--out-dir", default=os.path.join(REPO, "runs", "warm"))
     args = p.parse_args()
 
+    if args.specs is None:
+        args.specs = default_specs()
     for spec in args.specs:
         parse(spec)  # fail fast on typos, before any jax import anywhere
 
