@@ -177,6 +177,31 @@ def _compile_plan() -> dict | None:
         return None
 
 
+def _journal_provenance() -> dict | None:
+    """Durable-service journal provenance from runs/service_chaos.json
+    (the SLO line tools/service_chaos.py banks): per-scenario records
+    replayed / jobs re-adopted on restart, or None when the artifact is
+    missing, unparseable, or STALE (_artifact_fresh). Sits next to the
+    "resume" dict: resume is THIS run's recovery story, journal is the
+    service tier's."""
+    try:
+        path = os.path.join(RUNS, "service_chaos.json")
+        if not _artifact_fresh(path):
+            return None
+        with open(path) as fh:
+            line = json.load(fh)
+        return {
+            "seed": line.get("seed"),
+            "ok": line.get("ok"),
+            "scenarios": {
+                name: rep.get("journal")
+                for name, rep in line.get("scenarios", {}).items()
+            },
+        }
+    except Exception:
+        return None
+
+
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
     os.makedirs(RUNS, exist_ok=True)
@@ -647,6 +672,11 @@ def _worker(platform: str) -> None:
                         "states_at_resume": states0,
                         "levels_replayed": 0,
                     },
+                    # Durable-service provenance (docs/service.md
+                    # "Durability & recovery"): the latest seeded
+                    # service_chaos sweep's journal verdicts — records
+                    # replayed and jobs re-adopted across restarts.
+                    "journal": _journal_provenance(),
                     # stpu-lint provenance (docs/static-analysis.md):
                     # the latest runs/lint.json verdict — True/False, or
                     # None when no lint artifact exists (run

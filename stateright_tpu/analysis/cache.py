@@ -11,10 +11,12 @@ are applied after the sweep, to raw findings, so cached findings stay
 valid across waiver edits.
 
 Entries live under ``runs/lint_cache/<tree12>/<slug>.json`` (``runs/``
-is gitignored); stale tree dirs are pruned on first write so the cache
-never accumulates. ``--no-cache`` forces a fresh sweep; surfaces that
-ERRORED or SKIPPED are never cached (an environment verdict is not a
-tree verdict).
+is gitignored); growth is bounded to the NEWEST ``KEEP_TREES`` tree
+dirs (by mtime; ``STPU_LINT_CACHE_KEEP`` overrides) — pruned at lint
+startup and on write, so per-commit content-hash dirs never accumulate
+while a couple of recent trees (branch switches, A/B edits) stay warm.
+``--no-cache`` forces a fresh sweep; surfaces that ERRORED or SKIPPED
+are never cached (an environment verdict is not a tree verdict).
 """
 
 from __future__ import annotations
@@ -31,6 +33,10 @@ from .rules import Finding
 _PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REPO = os.path.dirname(_PKG)
 DEFAULT_CACHE_DIR = os.path.join(_REPO, "runs", "lint_cache")
+
+#: Newest tree dirs retained (per-commit content hashes would otherwise
+#: accumulate forever on a long-lived box); env STPU_LINT_CACHE_KEEP.
+KEEP_TREES = 4
 
 _tree_hash_memo: Optional[str] = None
 
@@ -73,13 +79,44 @@ def _slug(surface: str) -> str:
 
 
 class SurfaceCache:
-    """get/put of raw (pre-waiver) surface findings under one tree hash."""
+    """get/put of raw (pre-waiver) surface findings under one tree hash,
+    bounded to the newest :data:`KEEP_TREES` tree dirs."""
 
-    def __init__(self, cache_dir: Optional[str] = None):
+    def __init__(self, cache_dir: Optional[str] = None,
+                 keep_trees: Optional[int] = None):
         self.root = cache_dir or DEFAULT_CACHE_DIR
         self.tree = tree_hash()[:12]
         self.dir = os.path.join(self.root, self.tree)
-        self._pruned = False
+        if keep_trees is None:
+            try:
+                keep_trees = int(
+                    os.environ.get("STPU_LINT_CACHE_KEEP", KEEP_TREES)
+                )
+            except ValueError:
+                keep_trees = KEEP_TREES
+        self.keep_trees = max(1, keep_trees)
+        # Prune at startup too, not just on write: a lint run on an
+        # unchanged tree (all hits, no puts) must still bound the cache.
+        self._prune()
+
+    def _prune(self) -> None:
+        """Delete all but the newest ``keep_trees`` tree dirs (by mtime;
+        the current tree always counts as newest — a warm hit must never
+        prune the entries it is about to read)."""
+        try:
+            others = sorted(
+                (
+                    d for d in os.listdir(self.root)
+                    if d != self.tree
+                    and os.path.isdir(os.path.join(self.root, d))
+                ),
+                key=lambda d: os.path.getmtime(os.path.join(self.root, d)),
+                reverse=True,
+            )
+        except OSError:  # pragma: no cover - cache is best-effort
+            return
+        for d in others[self.keep_trees - 1:]:
+            shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)
 
     def get(self, surface: str) -> Optional[List[Finding]]:
         path = os.path.join(self.dir, _slug(surface) + ".json")
@@ -99,17 +136,8 @@ class SurfaceCache:
             return None
 
     def put(self, surface: str, findings: List[Finding]) -> None:
-        # Prune other trees' dirs the first time this instance writes —
-        # the cache holds exactly one tree's results.
         try:
             os.makedirs(self.dir, exist_ok=True)
-            if not self._pruned:
-                self._pruned = True
-                for d in os.listdir(self.root):
-                    if d != self.tree:
-                        shutil.rmtree(
-                            os.path.join(self.root, d), ignore_errors=True
-                        )
         except OSError:  # pragma: no cover - cache is best-effort
             return
         payload = {

@@ -54,6 +54,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import chaos
+
 # v2: fingerprints moved to the Zobrist-form hash (ops/fphash.py) and the
 # metadata gained the model-config digest; v1 checkpoints persist fingerprints
 # under the old hash and must be rejected, not silently resumed.
@@ -200,6 +202,13 @@ def save_checkpoint(checker, path: str, keep: int = 1) -> None:
                     os.replace(older, f"{dst}.{i}")
             os.replace(dst, f"{dst}.1")
         os.replace(tmp, dst)
+        inj = chaos.fire("checkpoint.torn", size=os.path.getsize(dst))
+        if inj is not None:
+            # Deterministic fault injection (stateright_tpu/chaos.py):
+            # tear the just-written live rotation at byte ``at`` — the
+            # corrupt-newest shape latest_valid_checkpoint falls back
+            # from. No-op unless an STPU_CHAOS plan names it.
+            chaos.tear_file(dst, inj.get("at", 1))
     finally:
         # Only a failed save leaves the temp behind (success replaced it).
         try:

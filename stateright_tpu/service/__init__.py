@@ -16,12 +16,16 @@ from .core import (
     Job,
     ServiceConfig,
 )
+from .journal import Journal, JournalTorn, read_journal
 from .registry import SHIPPED, resolve
 
 __all__ = [
     "AdmissionError",
     "CheckerService",
     "Job",
+    "Journal",
+    "JournalTorn",
+    "read_journal",
     "SERVICE_COUNTERS",
     "ServiceConfig",
     "SHIPPED",

@@ -35,16 +35,35 @@ isolated per job and the pool degrades instead of dying:
   :meth:`job_trace_chrome` (reusing ``obs.export_chrome``). The Explorer
   is one client: ``make_app``/``serve`` register their interactive checker
   as a pool job and embed the gauges in ``/.status``.
+- **Durability** (``service/journal.py``; docs/service.md "Durability &
+  recovery") — every batch-job transition appends a typed, self-verifying
+  record to ``<run_dir>/journal.jsonl``. Constructing a service over a
+  run dir that already has a journal REPLAYS it: journal-complete jobs
+  restore done/failed without re-running, in-flight and queued jobs
+  requeue (wall-clock already spent is charged; each re-adopts its
+  latest valid checkpoint rotation through the normal resume path, and
+  any orphaned worker the dead incarnation left running is killed by its
+  journaled pid first), breaker/quarantine state restores (an open
+  breaker re-probes immediately), and ``submit(idempotency_key=...)``
+  dedupes client resubmissions across the restart — so a supervisor can
+  wrap the service *itself* in ``supervise.supervise()`` exactly like a
+  worker: kill -9 at any instant, restart into the same job set.
 
 Like the supervisor it builds on, importing this module never imports jax
 — the service process stays wedge-proof; only workers and the prober (both
-subprocesses) touch a backend.
+subprocesses) touch a backend. Fault injection for every recovery path
+here is the deterministic chaos layer (``stateright_tpu/chaos.py``,
+``STPU_CHAOS`` / ``ServiceConfig(chaos=)``); ``tools/service_chaos.py``
+drives seeded kill/restart schedules against one pool and asserts the
+exactly-once invariant.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import signal
 import subprocess
 import sys
 import threading
@@ -52,10 +71,12 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
+from .. import chaos as chaos_mod
 from .. import supervise as sup
 from ..checkpoint import latest_valid_checkpoint
 from ..obs import Counters, export_chrome
 from . import registry
+from .journal import Journal, read_journal
 
 #: Pre-seeded pool counters (stable ``metrics()`` key set, like the
 #: engines' ENGINE_COUNTERS; docs/service.md).
@@ -75,6 +96,10 @@ SERVICE_COUNTERS = (
     "lint_checks",
     "lint_rejects",
     "lint_errors",
+    "idem_dedups",
+    "jobs_recovered",
+    "orphans_killed",
+    "artifacts_swept",
 )
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
@@ -148,6 +173,25 @@ class ServiceConfig:
     compile_cache: Optional[str] = None  #: default: <cwd>/.jax_cache
     checkpoint_every: Any = 1  #: per-job auto-checkpoint cadence
     checkpoint_keep: int = 3
+    # -- durability (service/journal.py; docs/service.md) ------------------
+    #: Append every batch-job transition to <run_dir>/journal.jsonl and
+    #: REPLAY it when constructed over a run dir that already has one —
+    #: the queue, budgets, breaker, and checkpoint pointers survive a
+    #: service kill -9. Off = the pre-durability in-memory pool.
+    journal: bool = True
+    journal_compact_every: int = 256  #: appends between snapshot compactions
+    journal_keep: int = 3  #: journal rotations retained by compaction
+    #: Seconds a journal-complete job's run-dir artifacts (heartbeat,
+    #: trace, checkpoint rotations, worker stdout) are retained before
+    #: the sweep deletes its job dir (gauge: ``artifacts_swept``); None
+    #: disables sweeping.
+    artifact_retention_s: Optional[float] = 7 * 24 * 3600.0
+    # -- fault injection (stateright_tpu/chaos.py) -------------------------
+    #: A chaos spec installed process-wide at construction and exported
+    #: to worker environments as STPU_CHAOS — the deterministic fault
+    #: layer the chaos/restart drills script (None: inherit env, which
+    #: is a no-op when STPU_CHAOS is unset).
+    chaos: Optional[str] = None
 
 
 class Job:
@@ -165,11 +209,13 @@ class Job:
         max_seconds: float = 600.0,
         max_states: Optional[int] = None,
         chaos: Optional[Dict[str, Any]] = None,
+        idempotency_key: Optional[str] = None,
     ):
         self._service = service
         self.id = job_id
         self.spec = spec
         self.kind = kind  #: "batch" | "interactive"
+        self.idempotency_key = idempotency_key
         self.status = "queued"  #: queued|running|quarantined|done|failed
         self.engine = "xla"  #: engine of the current/last attempt
         self.degraded = False  #: served by the host fallback
@@ -186,6 +232,9 @@ class Job:
         self.result: Optional[Dict[str, Any]] = None
         self.error: Optional[str] = None
         self.created_unix_ts = time.time()
+        self.completed_unix_ts: Optional[float] = None
+        self.recovered = False  #: restored from a journal replay
+        self.swept = False  #: run-dir artifacts removed by the retention sweep
         self.checker = None  #: interactive jobs only
         self.dir: Optional[str] = None
         self._proc = None  #: live worker Popen (close-with-kill path)
@@ -238,6 +287,7 @@ class Job:
             "resumed_from": self.resumed_from,
             "lint": self.lint,
             "error": self.error,
+            "recovered": self.recovered,
         }
         if self.result is not None:
             out["result"] = {
@@ -245,6 +295,45 @@ class Job:
                 for k in ("generated", "unique", "max_depth", "seconds")
             }
         return out
+
+    def persist(self) -> Dict[str, Any]:
+        """The journal-snapshot form: everything a restarted service needs
+        to re-adopt this job (``service/journal.py``; paths relative to
+        the service run dir so a relocated run dir still replays).
+        Caller holds the service lock."""
+        run_dir = self._service._cfg.run_dir
+        return {
+            "spec": self.spec,
+            "status": self.status,
+            "max_seconds": self.max_seconds,
+            "max_states": self.max_states,
+            "chaos": self.chaos or None,
+            "idempotency_key": self.idempotency_key,
+            "dir": (
+                os.path.relpath(self.dir, run_dir)
+                if self.dir is not None
+                else None
+            ),
+            "engine": self.engine,
+            "degraded": self.degraded,
+            "consumed_s": self.consumed_s,
+            "requeues": self.requeues,
+            "wedges": self.wedges,
+            "error": self.error,
+            "result": (
+                {
+                    k: self.result.get(k)
+                    for k in (
+                        "generated", "unique", "max_depth", "seconds",
+                        "degraded",
+                    )
+                }
+                if self.result is not None
+                else None
+            ),
+            "created_unix_ts": self.created_unix_ts,
+            "completed_unix_ts": self.completed_unix_ts,
+        }
 
     def metrics(self) -> Optional[Dict[str, Any]]:
         """The per-job engine snapshot: a finished batch job's recorded
@@ -256,11 +345,146 @@ class Job:
         return None
 
 
+#: Pool-counter increments implied by each replayed journal event —
+#: recovery restores counters from the last snapshot verbatim, then
+#: re-applies these for the events after it. Best-effort telemetry
+#: (rejections and lint checks are not journaled), never an invariant.
+_COUNTER_EFFECTS = {
+    "submitted": ("submitted", "admitted"),
+    "breaker_tripped": ("breaker_trips",),
+    "breaker_closed": ("breaker_closes",),
+}
+
+
+def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a journal's records into the recoverable pool state: the last
+    ``snapshot`` (if any) as the base, every later event applied on top.
+    Pure — the unit the torn-tail tests pin without a service."""
+    state: Dict[str, Any] = {
+        "next_id": 0,
+        "breaker": "closed",
+        "consecutive_wedges": 0,
+        "breaker_opened_unix_ts": None,
+        "counters": {},
+        "idem": {},
+        "jobs": {},
+        "order": [],
+        "last_ts": 0.0,
+    }
+
+    def counters_inc(name: str, n: int = 1) -> None:
+        state["counters"][name] = state["counters"].get(name, 0) + n
+
+    for rec in records:
+        state["last_ts"] = max(state["last_ts"], float(rec.get("ts", 0.0)))
+        ev = rec["event"]
+        for name in _COUNTER_EFFECTS.get(ev, ()):
+            counters_inc(name)
+        if ev == "snapshot":
+            s = rec["state"]
+            state["next_id"] = s.get("next_id", state["next_id"])
+            state["breaker"] = s.get("breaker", "closed")
+            state["consecutive_wedges"] = s.get("consecutive_wedges", 0)
+            state["breaker_opened_unix_ts"] = s.get("breaker_opened_unix_ts")
+            state["counters"] = dict(s.get("counters", {}))
+            state["idem"] = dict(s.get("idem", {}))
+            state["jobs"] = {j: dict(v) for j, v in s.get("jobs", {}).items()}
+            state["order"] = [
+                j for j in s.get("order", list(state["jobs"]))
+                if j in state["jobs"]
+            ]
+            continue
+        if ev == "recovered":
+            continue
+        if ev == "breaker_tripped":
+            state["breaker"] = "open"
+            state["breaker_opened_unix_ts"] = rec["ts"]
+            state["consecutive_wedges"] = rec.get(
+                "consecutive", state["consecutive_wedges"]
+            )
+            continue
+        if ev == "breaker_closed":
+            state["breaker"] = "closed"
+            state["breaker_opened_unix_ts"] = None
+            state["consecutive_wedges"] = 0
+            continue
+        jid = rec.get("job")
+        if jid is None:
+            continue
+        if ev == "submitted":
+            job = {
+                "spec": rec["spec"],
+                "status": "queued",
+                "max_seconds": rec.get("max_seconds", 600.0),
+                "max_states": rec.get("max_states"),
+                "chaos": rec.get("chaos"),
+                "idempotency_key": rec.get("idempotency_key"),
+                "dir": rec.get("dir"),
+                "engine": "xla",
+                "degraded": False,
+                "consumed_s": 0.0,
+                "requeues": 0,
+                "wedges": 0,
+                "error": None,
+                "result": None,
+                "created_unix_ts": rec["ts"],
+                "completed_unix_ts": None,
+            }
+            state["jobs"][jid] = job
+            state["order"].append(jid)
+            if job["idempotency_key"]:
+                state["idem"][job["idempotency_key"]] = jid
+            try:
+                state["next_id"] = max(
+                    state["next_id"], int(jid.rsplit("-", 1)[-1])
+                )
+            except ValueError:
+                pass
+            continue
+        job = state["jobs"].get(jid)
+        if job is None:  # an event for a job the torn prefix never admitted
+            continue
+        if ev == "started":
+            job["status"] = "running"
+            job["started_ts"] = rec["ts"]
+            job["pid"] = rec.get("pid")
+            job["engine"] = rec.get("engine", job["engine"])
+            job["degraded"] = job["degraded"] or job["engine"] == "host"
+        elif ev == "budget_charged":
+            job["consumed_s"] = rec.get("consumed_s", job["consumed_s"])
+            job["pid"] = None  # the attempt was reaped; no orphan to kill
+        elif ev == "quarantined":
+            job["status"] = "quarantined"
+            job["requeues"] = rec.get("requeues", job["requeues"])
+            job["wedges"] = rec.get("wedges", job["wedges"])
+            job["pid"] = None
+            counters_inc("requeues")
+            counters_inc(
+                "wedge_verdicts" if rec.get("wedged") else "crashes"
+            )
+        elif ev == "completed":
+            job["status"] = rec["status"]
+            job["error"] = rec.get("error")
+            job["result"] = rec.get("result", job.get("result"))
+            job["completed_unix_ts"] = rec["ts"]
+            job["pid"] = None
+            counters_inc(
+                "jobs_done" if rec["status"] == "done" else "jobs_failed"
+            )
+        elif ev == "checkpointed":
+            job["checkpointed"] = True
+    return state
+
+
 class CheckerService:
     """The device's owner: N concurrent checking jobs behind admission
     control, per-job supervision, and a degradation breaker. Construction
     is cheap (no threads, no dirs) — the scheduler thread starts on the
-    first :meth:`submit`, the prober when the breaker opens."""
+    first :meth:`submit`, the prober when the breaker opens — UNLESS the
+    run dir already holds a job journal, in which case construction
+    replays it (docs/service.md "Durability & recovery") and restarts
+    whatever the replay says is still due: the scheduler for requeued
+    jobs, the prober for a restored-open breaker."""
 
     def __init__(self, config: Optional[ServiceConfig] = None, **overrides):
         if config is not None and overrides:
@@ -297,6 +521,24 @@ class CheckerService:
         self._prober: Optional[threading.Thread] = None
         self._session_dir: Optional[str] = None
         self.log = lambda msg: None  #: swap in print for a chatty service
+        #: idempotency key -> job id (``submit(idempotency_key=...)``
+        #: dedupe; survives restarts through the journal).
+        self._idem: Dict[str, str] = {}
+        self._journal: Optional[Journal] = None
+        self._recovery: Optional[Dict[str, Any]] = None
+        if self._cfg.chaos:
+            # The deterministic fault layer: installed process-wide for
+            # the service-side seams (journal writer, run_worker polls)
+            # and exported to worker envs in _worker_env.
+            chaos_mod.install(self._cfg.chaos)
+        if self._cfg.journal:
+            self._journal = Journal(
+                os.path.join(self._cfg.run_dir, "journal.jsonl"),
+                keep=self._cfg.journal_keep,
+                compact_every=self._cfg.journal_compact_every,
+            )
+            if os.path.exists(self._journal.path):
+                self._recover()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -323,7 +565,11 @@ class CheckerService:
             for j in self._jobs.values():
                 # Running batch jobs are settled by their _run_job thread
                 # (it re-checks _closed under the lock); interactive jobs
-                # just end with the pool.
+                # just end with the pool. These close-time settlements
+                # are for in-memory WAITERS only and are never journaled
+                # as completed: with durability on, unfinished work stays
+                # queued/running in the journal, and the next incarnation
+                # over this run dir requeues it.
                 if j.status in ("queued", "quarantined"):
                     j.status = "failed"
                     j.error = "service closed"
@@ -338,6 +584,8 @@ class CheckerService:
         for t in (self._scheduler, self._prober):
             if t is not None:
                 t.join(timeout=timeout)
+        if self._journal is not None:
+            self._journal.close()
 
     def _ensure_session_dir(self) -> str:
         if self._session_dir is None:
@@ -355,6 +603,276 @@ class CheckerService:
                 daemon=True,
             )
             self._scheduler.start()
+
+    def _start_prober(self, immediate: bool = False) -> None:
+        """The background breaker prober; with ``immediate`` (a restart
+        that recovered an OPEN breaker) the first probe fires now instead
+        of after ``probe_interval_s`` — a restarted pool must not send
+        its first job at a possibly-wedged device just because the
+        incarnation that observed the wedges died."""
+        target = self._probe_loop
+        if immediate:
+            def target() -> None:  # noqa: F811 - deliberate shadowing
+                self.probe_device_now()
+                self._probe_loop()
+        self._prober = threading.Thread(
+            target=target, name="stpu-service-prober", daemon=True,
+        )
+        self._prober.start()
+
+    # -- durability (service/journal.py) -----------------------------------
+
+    def _jlog(self, event: str, **payload: Any) -> None:
+        """Append one journal record (caller holds the lock; no-op with
+        journaling off). Compaction rides here: past the cadence the log
+        is rewritten as one snapshot of the current state."""
+        j = self._journal
+        if j is None:
+            return
+        j.append(event, ts=time.time(), **payload)
+        if j.compaction_due:
+            j.compact(self._snapshot_payload(), ts=time.time())
+
+    def _snapshot_payload(self) -> Dict[str, Any]:
+        """The full recoverable pool state (caller holds the lock):
+        the journal compaction's snapshot record, and the base a replay
+        folds later events onto. Interactive jobs are deliberately
+        absent — a live session cannot survive its process."""
+        return {
+            "next_id": self._next_id,
+            "breaker": self._breaker,
+            "consecutive_wedges": self._consecutive_wedges,
+            "breaker_opened_unix_ts": self._breaker_opened_unix_ts,
+            "counters": self._counters.snapshot(),
+            "idem": dict(self._idem),
+            "order": [
+                jid for jid in self._order
+                if self._jobs[jid].kind == "batch"
+            ],
+            "jobs": {
+                jid: self._jobs[jid].persist()
+                for jid in self._order
+                if self._jobs[jid].kind == "batch"
+            },
+        }
+
+    def _recover(self) -> None:
+        """Replay ``<run_dir>/journal.jsonl`` into a live pool: the
+        restart-recovery half of the durability contract (docs/service.md
+        "Durability & recovery"). A torn tail is recovered-from, not
+        fatal: the torn record is dropped, everything before it replays,
+        and the recompaction below amputates the torn bytes so appends
+        never land after them."""
+        replay = read_journal(self._journal.path)
+        state = _replay_state(replay.records)
+        now = time.time()
+        run_dir = self._cfg.run_dir
+        readopted = 0
+        requeued = 0
+        expired: List[Job] = []
+        orphans: List[tuple] = []
+        with self._cond:
+            self._next_id = max(self._next_id, state["next_id"])
+            self._breaker = state["breaker"]
+            self._consecutive_wedges = state["consecutive_wedges"]
+            self._breaker_opened_unix_ts = state["breaker_opened_unix_ts"]
+            self._idem.update(state["idem"])
+            for name, value in state["counters"].items():
+                # jobs_recovered/orphans_killed are per-INCARNATION (they
+                # mirror the recovery provenance dict); restoring them
+                # from a previous incarnation's snapshot would double-
+                # count across a restart loop. Everything else is
+                # lifetime-cumulative.
+                if value and name not in ("jobs_recovered", "orphans_killed"):
+                    self._counters.inc(name, value)
+            for jid in state["order"]:
+                rec = state["jobs"][jid]
+                job = Job(
+                    self,
+                    jid,
+                    rec["spec"],
+                    max_seconds=rec["max_seconds"],
+                    max_states=rec.get("max_states"),
+                    chaos=rec.get("chaos"),
+                    idempotency_key=rec.get("idempotency_key"),
+                )
+                job.recovered = True
+                job.created_unix_ts = rec.get("created_unix_ts", now)
+                job.dir = (
+                    os.path.join(run_dir, rec["dir"])
+                    if rec.get("dir")
+                    else None
+                )
+                job.engine = rec.get("engine", "xla")
+                job.degraded = bool(rec.get("degraded"))
+                job.consumed_s = float(rec.get("consumed_s", 0.0))
+                job.requeues = int(rec.get("requeues", 0))
+                job.wedges = int(rec.get("wedges", 0))
+                job.error = rec.get("error")
+                status = rec["status"]
+                if status in ("done", "failed"):
+                    # Journal-complete: restore the terminal verdict,
+                    # never re-run. The full result (discovery paths
+                    # included) reloads from the job dir when the sweep
+                    # has not reclaimed it; the journaled summary is the
+                    # fallback.
+                    job.status = status
+                    job.completed_unix_ts = rec.get("completed_unix_ts")
+                    job.result = rec.get("result")
+                    result_path = (
+                        os.path.join(job.dir, "result.json")
+                        if job.dir is not None
+                        else None
+                    )
+                    if result_path is not None and os.path.exists(result_path):
+                        try:
+                            with open(result_path) as fh:
+                                job.result = json.load(fh)
+                        except (OSError, json.JSONDecodeError):
+                            pass
+                else:
+                    # Queued / quarantined / in-flight: requeue. An
+                    # in-flight job charges the wall-clock it had already
+                    # spent when the pool died (the journal's last
+                    # timestamp bounds "the pool was still alive here")
+                    # and its worker — orphaned by the pool's death, both
+                    # run in their own sessions — is killed by journaled
+                    # pid before the scheduler can double-run the job.
+                    if status == "running":
+                        started = rec.get("started_ts")
+                        if started is not None:
+                            job.consumed_s += max(
+                                0.0, state["last_ts"] - started
+                            )
+                        if rec.get("pid"):
+                            orphans.append((int(rec["pid"]), job))
+                    if job.max_seconds - job.consumed_s <= 0:
+                        job.status = "failed"
+                        job.error = (
+                            "wall-clock budget exhausted "
+                            "(spent before the restart)"
+                        )
+                        job.completed_unix_ts = now
+                        self._counters.inc("jobs_failed")
+                        expired.append(job)
+                    else:
+                        job.status = "queued"
+                        requeued += 1
+                        # Existence, not validity: _run_job_inner's
+                        # latest_valid_checkpoint does the (decompress +
+                        # digest) verification at spawn time; this is
+                        # provenance, cheap under the lock.
+                        if job.dir is not None and (
+                            os.path.exists(job.checkpoint_path)
+                            or os.path.exists(job.checkpoint_path + ".1")
+                        ):
+                            readopted += 1
+                self._jobs[jid] = job
+                self._order.append(jid)
+                self._counters.inc("jobs_recovered")
+        killed = 0
+        for pid, job in orphans:
+            if self._kill_orphan(pid, job):
+                killed += 1
+        self._recovery = {
+            "records_replayed": len(replay.records),
+            "torn": replay.torn,
+            "jobs_recovered": len(state["order"]),
+            "jobs_requeued": requeued,
+            "jobs_readopted": readopted,
+            "jobs_expired": len(expired),
+            "orphans_killed": killed,
+        }
+        with self._cond:
+            if killed:
+                self._counters.inc("orphans_killed", killed)
+            # Recompact: the journal becomes [snapshot, recovered, ...] —
+            # bounded growth across restart loops, and a torn tail can
+            # never be appended after.
+            self._journal.seq = (
+                replay.records[-1]["seq"] if replay.records else 0
+            )
+            # The snapshot already carries the expired jobs settled as
+            # failed (status, error, completed_unix_ts, counters) —
+            # appending separate `completed` events here would replay ON
+            # TOP of it at the next restart and double-count
+            # jobs_failed.
+            self._journal.compact(self._snapshot_payload(), ts=time.time())
+            self._jlog("recovered", **self._recovery)
+            self._sweep_artifacts(now)
+            runnable = any(
+                j.kind == "batch" and not j.done
+                for j in self._jobs.values()
+            )
+            self._cond.notify_all()
+        if runnable:
+            self._ensure_scheduler()
+        if self._breaker == "open" and self._cfg.probe_auto:
+            self._start_prober(immediate=True)
+
+    def _kill_orphan(self, pid: int, job: Job) -> bool:
+        """Best-effort kill of a worker the dead incarnation left running
+        (journaled pid; workers lead their own sessions, so the pool's
+        death never took them down). Guarded against pid reuse: only a
+        process whose command line still looks like our worker body is
+        touched."""
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmdline = fh.read().replace(b"\0", b" ").decode(
+                    errors="replace"
+                )
+        except OSError:
+            return False  # already gone
+        if "worker.py" not in cmdline and "service.worker" not in cmdline:
+            return False  # pid reused by something that is not ours
+        self.log(f"killing orphaned worker pid {pid} ({job.id})")
+        # Straight to SIGKILL: the orphan's incarnation is gone, nothing
+        # coordinates a graceful stop, and a SIGSTOP-frozen worker would
+        # sit on TERM forever (the same reasoning as _kill_group's last
+        # resort). run_worker spawns workers as session leaders, so the
+        # pid doubles as the pgid; fall back to the single process if
+        # the group is already gone.
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            return False
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                return False
+        return True
+
+    def _sweep_artifacts(self, now: Optional[float] = None) -> None:
+        """Reclaim journal-complete jobs' run-dir artifacts (heartbeat,
+        trace, checkpoint rotations, worker stdout) past the retention —
+        a long-lived service must not grow ``runs/service/`` without
+        bound. Caller holds the lock; gauge: ``artifacts_swept``."""
+        retention = self._cfg.artifact_retention_s
+        if retention is None:
+            return
+        now = time.time() if now is None else now
+        for job in self._jobs.values():
+            if (
+                job.kind != "batch"
+                or not job.done
+                or job.swept
+                or job.dir is None
+                or job.completed_unix_ts is None
+                or now - job.completed_unix_ts < retention
+            ):
+                continue
+            if os.path.isdir(job.dir):
+                shutil.rmtree(job.dir, ignore_errors=True)
+            job.swept = True
+            self._counters.inc("artifacts_swept")
+            try:
+                # A previous incarnation's session dir, once empty, goes
+                # too (rmdir refuses non-empty dirs — live siblings keep
+                # theirs).
+                os.rmdir(os.path.dirname(job.dir))
+            except OSError:
+                pass
 
     # -- admission ---------------------------------------------------------
 
@@ -435,6 +953,14 @@ class CheckerService:
         verdict: Dict[str, Any]
         try:
             try:
+                if chaos_mod.fire("lint.timeout") is not None:
+                    # Deterministic fault injection: the admission-lint
+                    # subprocess "timing out" — the fail-open tooling-
+                    # error path, without waiting out a real timeout.
+                    raise subprocess.TimeoutExpired(
+                        argv, self._cfg.lint_timeout_s,
+                        output="chaos: simulated admission-lint timeout",
+                    )
                 proc = subprocess.run(
                     argv,
                     timeout=self._cfg.lint_timeout_s,
@@ -495,13 +1021,22 @@ class CheckerService:
         max_seconds: Optional[float] = None,
         max_states: Optional[int] = None,
         chaos: Optional[Dict[str, Any]] = None,
+        idempotency_key: Optional[str] = None,
     ) -> Job:
         """Queues one batch checking job; returns its :class:`Job` handle
         or raises :class:`AdmissionError` (queue full → carries
         ``retry_after_s``; an over-cap budget → no retry hint, shrink the
         request; an unwaived flight-check finding → no retry hint, fix
         the spec). Unknown/malformed specs raise ``ValueError`` before
-        any admission accounting."""
+        any admission accounting.
+
+        ``idempotency_key`` dedupes client resubmissions — across
+        restarts too (the key rides the journal): a key the pool already
+        knows returns the EXISTING job (terminal or not; a client that
+        wants a genuine re-run picks a new key) with no admission
+        accounting beyond the ``idem_dedups`` counter. This is what lets
+        a supervisor restart loop blindly resubmit its whole schedule
+        after a service crash and converge to exactly-once."""
         registry.parse(spec)  # typed spec validation, pre-admission
         with self._lock:
             # Pre-flight closed check: a closed pool must reject
@@ -510,6 +1045,11 @@ class CheckerService:
             # guards the race.
             if self._closed:
                 raise RuntimeError("service is closed")
+            if idempotency_key is not None:
+                known = self._jobs.get(self._idem.get(idempotency_key, ""))
+                if known is not None:
+                    self._counters.inc("idem_dedups")
+                    return known
         max_seconds = (
             self._cfg.default_max_seconds if max_seconds is None else max_seconds
         )
@@ -574,6 +1114,14 @@ class CheckerService:
                     f"queue full ({self._cfg.max_queue} waiting jobs)",
                     retry_after_s=self._retry_after(counts),
                 )
+            if idempotency_key is not None:
+                # Re-check under the final lock: a concurrent submit of
+                # the same key between the precheck and here must not
+                # admit the job twice.
+                known = self._jobs.get(self._idem.get(idempotency_key, ""))
+                if known is not None:
+                    self._counters.inc("idem_dedups")
+                    return known
             self._next_id += 1
             job = Job(
                 self,
@@ -582,13 +1130,46 @@ class CheckerService:
                 max_seconds=max_seconds,
                 max_states=max_states,
                 chaos=chaos,
+                idempotency_key=idempotency_key,
             )
             job.lint = lint
             job.dir = os.path.join(self._ensure_session_dir(), job.id)
             os.makedirs(job.dir, exist_ok=True)
+            # Pool-level chaos plan -> job-level worker sabotage: the
+            # N-th submitted job (the plan's @n trigger counts submits)
+            # gets the matching worker flag. `once` (default) arms the
+            # exactly-once marker so the requeued attempt runs clean.
+            for point, key in (
+                ("worker.die", "die_at_depth"),
+                ("worker.freeze", "freeze_at_depth"),
+            ):
+                inj = chaos_mod.fire(point)
+                if inj is not None:
+                    job.chaos.setdefault(key, int(inj.get("depth", 3)))
+                    if inj.get("once", 1):
+                        job.chaos.setdefault(
+                            "marker", os.path.join(job.dir, "chaos.marker")
+                        )
+            if idempotency_key is not None:
+                self._idem[idempotency_key] = job.id
             self._jobs[job.id] = job
             self._order.append(job.id)
             self._counters.inc("admitted")
+            self._jlog(
+                "submitted",
+                job=job.id,
+                spec=spec,
+                max_seconds=max_seconds,
+                max_states=max_states,
+                chaos=job.chaos or None,
+                idempotency_key=idempotency_key,
+                dir=os.path.relpath(job.dir, self._cfg.run_dir),
+            )
+            self._jlog(
+                "admitted",
+                job=job.id,
+                lint_ok=None if lint is None else lint["ok"],
+            )
             self._ensure_scheduler()
             self._cond.notify_all()
         return job
@@ -720,6 +1301,11 @@ class CheckerService:
         if device:
             env["STPU_TRACE"] = job.trace_path
         env["STPU_COMPILE_CACHE"] = self._cfg.compile_cache
+        if self._cfg.chaos:
+            # The config's chaos plan rides into every worker (each
+            # process replays its own deterministic schedule); a plain
+            # env STPU_CHAOS inherits anyway, like any other knob.
+            env["STPU_CHAOS"] = self._cfg.chaos
         return env
 
     def _run_job(self, job: Job) -> None:
@@ -735,7 +1321,12 @@ class CheckerService:
                 job._proc = None
                 job.status = "failed"
                 job.error = f"supervisor error: {type(e).__name__}: {e}"
+                job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._jlog(
+                    "completed", job=job.id, status="failed",
+                    error=job.error, result=None,
+                )
                 self._cond.notify_all()
 
     def _run_job_inner(self, job: Job) -> None:
@@ -748,7 +1339,12 @@ class CheckerService:
             with self._cond:
                 job.status = "failed"
                 job.error = "wall-clock budget exhausted"
+                job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._jlog(
+                    "completed", job=job.id, status="failed",
+                    error=job.error, result=None,
+                )
                 self._cond.notify_all()
             return
         resume = (
@@ -785,9 +1381,17 @@ class CheckerService:
             # close() snapshots live procs under the lock; a worker that
             # spawns in the close race is killed HERE instead of running
             # unsupervised for its whole budget after the pool is gone.
+            # The journaled pid is the restart-recovery orphan handle: a
+            # pool killed -9 here leaves this worker running (its own
+            # session), and the next incarnation kills it by this record
+            # before re-scheduling the job.
             with self._cond:
                 job._proc = proc
                 closed = self._closed
+                self._jlog(
+                    "started", job=job.id, attempt=attempt, engine=engine,
+                    resumed_from=resume, pid=proc.pid,
+                )
             if closed:
                 sup._kill_group(proc)
 
@@ -850,7 +1454,15 @@ class CheckerService:
                     "resumed_from": resume,
                 }
             )
+            self._jlog(
+                "budget_charged", job=job.id, seconds=res.seconds,
+                consumed_s=job.consumed_s, charged=not res.wedged,
+            )
             if self._closed:
+                # Settles the in-memory waiters only — deliberately NOT
+                # journaled as completed: a durable pool's unfinished
+                # work stays queued in the journal for the next
+                # incarnation (docs/service.md "Durability & recovery").
                 job.status = "failed"
                 job.error = "service closed"
                 self._counters.inc("jobs_failed")
@@ -859,47 +1471,89 @@ class CheckerService:
             if result is not None:
                 job.status = "done"
                 job.result = result
+                job.completed_unix_ts = time.time()
                 if result.get("degraded"):
                     job.degraded = True
                     self._counters.inc("degraded_jobs")
                 self._counters.inc("jobs_done")
                 if device:
                     self._consecutive_wedges = 0
+                self._jlog(
+                    "completed", job=job.id, status="done", error=None,
+                    result=job.persist()["result"],
+                )
+                self._sweep_artifacts()
             elif res.wedged:
                 self._counters.inc("wedge_verdicts")
                 job.wedges += 1
                 self._record_wedge()
-                self._requeue_or_fail(job, f"wedge verdict: {res.killed}")
+                self._requeue_or_fail(
+                    job, f"wedge verdict: {res.killed}", wedged=True
+                )
             elif res.crashed:
                 self._counters.inc("crashes")
                 self._requeue_or_fail(
-                    job, f"worker died by signal (rc={res.rc})"
+                    job, f"worker died by signal (rc={res.rc})", wedged=False
                 )
             elif res.killed is not None or res.rc == 3:
                 job.status = "failed"
                 job.error = "wall-clock budget exhausted"
+                job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._jlog(
+                    "completed", job=job.id, status="failed",
+                    error=job.error, result=None,
+                )
             else:
                 job.status = "failed"
                 job.error = f"worker exited rc={res.rc}"
+                job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._jlog(
+                    "completed", job=job.id, status="failed",
+                    error=job.error, result=None,
+                )
             self._cond.notify_all()
 
-    def _requeue_or_fail(self, job: Job, reason: str) -> None:
+    def _requeue_or_fail(
+        self, job: Job, reason: str, *, wedged: bool = False
+    ) -> None:
         """Quarantine-and-requeue with exponential backoff, up to the
         requeue limit. Caller holds the lock."""
         if job.requeues < self._cfg.requeue_limit:
             job.requeues += 1
             self._counters.inc("requeues")
             job.status = "quarantined"
-            job.requeue_at = time.monotonic() + sup.backoff_delay(
-                job.requeues, self._cfg.backoff_s
+            delay = sup.backoff_delay(job.requeues, self._cfg.backoff_s)
+            job.requeue_at = time.monotonic() + delay
+            if job.dir is not None and (
+                os.path.exists(job.checkpoint_path)
+                or os.path.exists(job.checkpoint_path + ".1")
+            ):
+                # The re-adoptable resume pointer (provenance — the next
+                # attempt, this incarnation's or a restarted one's,
+                # re-resolves latest_valid_checkpoint itself).
+                self._jlog(
+                    "checkpointed", job=job.id,
+                    path=os.path.relpath(
+                        job.checkpoint_path, self._cfg.run_dir
+                    ),
+                )
+            self._jlog(
+                "quarantined", job=job.id, reason=reason, wedged=wedged,
+                requeues=job.requeues, wedges=job.wedges,
+                release_in_s=delay,
             )
             self.log(f"{job.id} quarantined ({reason})")
         else:
             job.status = "failed"
             job.error = f"{reason}; requeue limit reached"
+            job.completed_unix_ts = time.time()
             self._counters.inc("jobs_failed")
+            self._jlog(
+                "completed", job=job.id, status="failed",
+                error=job.error, result=None,
+            )
 
     # -- breaker -----------------------------------------------------------
 
@@ -913,16 +1567,15 @@ class CheckerService:
             self._breaker = "open"
             self._breaker_opened_unix_ts = time.time()
             self._counters.inc("breaker_trips")
+            self._jlog(
+                "breaker_tripped", consecutive=self._consecutive_wedges
+            )
             self.log(
                 f"breaker OPEN after {self._consecutive_wedges} consecutive "
                 "wedge verdicts; routing jobs to the host engine"
             )
             if self._cfg.probe_auto:
-                self._prober = threading.Thread(
-                    target=self._probe_loop, name="stpu-service-prober",
-                    daemon=True,
-                )
-                self._prober.start()
+                self._start_prober()
 
     @property
     def degraded(self) -> bool:
@@ -956,6 +1609,7 @@ class CheckerService:
                 self._breaker_opened_unix_ts = None
                 self._consecutive_wedges = 0
                 self._counters.inc("breaker_closes")
+                self._jlog("breaker_closed")
                 self.log("breaker CLOSED (device probe healthy)")
                 self._cond.notify_all()
         return ok
@@ -1014,6 +1668,19 @@ class CheckerService:
                     "k": self._cfg.breaker_k,
                     "opened_unix_ts": self._breaker_opened_unix_ts,
                 },
+                # Durability provenance (docs/service.md): the journal's
+                # position and — after a restart — what the replay
+                # restored; surfaces in the Explorer's /.pool unchanged.
+                "journal": (
+                    None
+                    if self._journal is None
+                    else {
+                        "path": self._journal.path,
+                        "records": self._journal.seq,
+                        "since_compact": self._journal.since_compact,
+                        "recovery": self._recovery,
+                    }
+                ),
                 **self._counters.snapshot(),
             }
 

@@ -46,6 +46,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Union
 
+from . import chaos
 from . import checkpoint as ck_mod
 from .obs import heartbeat as hb_mod
 
@@ -216,6 +217,14 @@ def run_worker(
             elapsed = time.monotonic() - t0
             if elapsed > timeout_s:
                 killed = f"hard timeout {timeout_s:.0f}s"
+                break
+            if chaos.fire("supervise.wedge") is not None:
+                # Deterministic fault injection (stateright_tpu/chaos.py):
+                # a scripted wedge verdict, classified exactly like a
+                # stale mid-dispatch heartbeat (WorkerResult.wedged) so
+                # quarantine/breaker paths are drivable without a real
+                # SIGSTOP. No-op unless an STPU_CHAOS plan names it.
+                killed = "chaos: simulated wedge verdict"
                 break
             if heartbeat is not None:
                 killed = heartbeat_verdict(

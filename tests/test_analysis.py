@@ -710,13 +710,42 @@ def test_surface_cache_invalidates_on_tree_change(tmp_path, monkeypatch):
                 message="m", excerpt="e")
     c1.put("s", [f])
     assert [x.message for x in c1.get("s")] == ["m"]
-    # A different tree hash misses (and prunes the old tree's entries on
-    # its first write).
+    # A different tree hash misses; the old tree's entries stay warm
+    # (within the keep-K bound) for branch switches.
     monkeypatch.setattr(cache_mod, "_tree_hash_memo", "f" * 64)
     c2 = cache_mod.SurfaceCache(str(tmp_path))
     assert c2.get("s") is None
     c2.put("s", [])
-    assert sorted(os.listdir(tmp_path)) == ["f" * 12]
+    assert "f" * 12 in os.listdir(tmp_path)
+    assert c1.dir.split(os.sep)[-1] in os.listdir(tmp_path)
+
+
+def test_surface_cache_bounds_tree_dirs(tmp_path, monkeypatch):
+    """Per-commit tree dirs must not accumulate forever: lint startup
+    keeps the newest K (current tree always included), deletes older."""
+    import time as time_mod
+
+    from stateright_tpu.analysis import cache as cache_mod
+
+    for i in range(6):
+        d = tmp_path / f"{i:012d}"
+        d.mkdir()
+        (d / "x.json").write_text("{}")
+        old = time_mod.time() - (10 - i) * 1000
+        os.utime(d, (old, old))
+    monkeypatch.setattr(cache_mod, "_tree_hash_memo", "a" * 64)
+    cache = cache_mod.SurfaceCache(str(tmp_path), keep_trees=3)
+    survivors = sorted(os.listdir(tmp_path))
+    # Newest keep-1 == 2 foreign dirs survive next to the current tree.
+    assert survivors == ["000000000004", "000000000005"]
+    cache.put("s", [])
+    assert sorted(os.listdir(tmp_path)) == [
+        "000000000004", "000000000005", "a" * 12
+    ]
+    # STPU_LINT_CACHE_KEEP drives the default.
+    monkeypatch.setenv("STPU_LINT_CACHE_KEEP", "1")
+    cache_mod.SurfaceCache(str(tmp_path))
+    assert sorted(os.listdir(tmp_path)) == ["a" * 12]
 
 
 # --- SARIF output ------------------------------------------------------------

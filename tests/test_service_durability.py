@@ -1,0 +1,594 @@
+"""Durable CheckerService pins (ISSUE 12 acceptance).
+
+The pool must survive its own death: a crash-safe job journal
+(``service/journal.py``), restart recovery in ``CheckerService``
+(re-adopt checkpoints, requeue in-flight work, dedupe resubmissions,
+restore the breaker), and the deterministic fault-injection layer
+(``stateright_tpu/chaos.py``) that drives every one of those paths on a
+seeded schedule instead of hand-rolled signals.
+
+- **Journal discipline**: sha256-per-record appends; a tail torn at a
+  RANDOM byte is a typed, recoverable condition — replay succeeds minus
+  the torn record; compaction rewrites the log as one snapshot,
+  atomically, with keep-K rotations.
+- **Restart recovery** (no workers needed — the journal is the
+  contract): journal-complete jobs restore done without re-running;
+  idempotent resubmission after a restart returns the SAME job; an
+  in-flight job whose budget was already spent fails typed, not re-run;
+  a restored-open breaker re-probes immediately.
+- **Chaos layer**: zero overhead with ``STPU_CHAOS`` unset (pinned);
+  seeded plans fire deterministically; the ``checkpoint.torn`` hook
+  tears a real rotation that ``latest_valid_checkpoint`` then falls
+  back from; ``supervise.wedge`` draws a scripted wedge verdict.
+- **Restart drills** (the real service, killed for real):
+  ``test_smoke_service_restart_resume`` (<30s, rides in
+  ``tools/smoke.sh``) — the service dies right after journaling
+  ``started``, the restart kills the orphaned worker, requeues, and
+  converges to exact pinned counts; the <60s 3-concurrent-job SIGKILL
+  and torn-tail convergence pins ride the ``tools/service_chaos.py``
+  harness (exactly-once, counts bit-identical to the undisturbed run).
+"""
+
+import importlib.util
+import json
+import os
+import random
+import time
+
+import pytest
+
+from stateright_tpu import chaos
+from stateright_tpu.service import (
+    CheckerService,
+    Journal,
+    JournalTorn,
+    ServiceConfig,
+    read_journal,
+)
+from stateright_tpu.service.core import _replay_state
+
+#: Pinned full-coverage (generated, unique) counts (bench.py EXPECTED_*).
+PINNED_2PC3 = (1_146, 288)
+
+
+def _harness():
+    """tools/service_chaos.py as an importable module (the harness the
+    restart drills drive; same trick test_analysis uses for warm_cache)."""
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "service_chaos.py"
+    )
+    spec = importlib.util.spec_from_file_location("service_chaos", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos(monkeypatch):
+    """Each test starts with no installed plan and no STPU_CHAOS."""
+    monkeypatch.delenv("STPU_CHAOS", raising=False)
+    chaos.install(None)
+    yield
+    chaos.install(None)
+
+
+def _config(tmp_path, **kw):
+    base = dict(
+        run_dir=str(tmp_path / "svc"),
+        platform="cpu",
+        default_max_seconds=420.0,
+        stall_s=8.0,
+        startup_grace_s=240.0,
+        poll_s=0.2,
+        backoff_s=0.1,
+        probe_auto=False,
+        admission_lint=False,
+    )
+    base.update(kw)
+    return ServiceConfig(**base)
+
+
+# --- the journal ------------------------------------------------------------
+
+
+def test_journal_round_trip_and_digests(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)
+    for i in range(4):
+        rec = j.append("submitted", ts=100.0 + i, job=f"job-{i:04d}",
+                       spec="2pc:3")
+        assert rec["seq"] == i + 1 and rec["sha256"]
+    replay = read_journal(path)
+    assert replay.torn is None
+    assert [r["job"] for r in replay.records] == [
+        f"job-{i:04d}" for i in range(4)
+    ]
+    # A tampered mid-file record fails its digest: replay stops there,
+    # typed — nothing after an untrusted record can be ordered.
+    lines = open(path).read().splitlines()
+    lines[1] = lines[1].replace("2pc:3", "2pc:9")
+    (tmp_path / "j.jsonl").write_text("\n".join(lines) + "\n")
+    tampered = read_journal(path)
+    assert len(tampered.records) == 1
+    assert "digest mismatch" in tampered.torn
+    with pytest.raises(JournalTorn):
+        read_journal(path, strict=True)
+
+
+def test_journal_torn_tail_at_random_byte(tmp_path):
+    """Truncate the journal at a RANDOM byte: replay returns the clean
+    prefix and reports the torn tail — never raises, never wedges."""
+    rng = random.Random(1234)
+    for _ in range(8):
+        path = str(tmp_path / f"j{rng.randint(0, 1 << 30)}.jsonl")
+        j = Journal(path)
+        for i in range(5):
+            j.append("submitted", ts=float(i), job=f"job-{i:04d}", spec="s")
+        j.close()
+        data = open(path, "rb").read()
+        cut = rng.randint(1, len(data) - 1)
+        with open(path, "wb") as fh:
+            fh.write(data[:cut])
+        replay = read_journal(path)
+        # Whole records before the cut replay; at most one record is
+        # lost. A cut exactly ON a record boundary leaves no torn
+        # evidence (the file just ends earlier) — every mid-record cut
+        # is reported.
+        complete = data[:cut].count(b"\n")
+        assert len(replay.records) == complete
+        assert (replay.torn is None) == data[:cut].endswith(b"\n")
+
+
+def test_journal_compaction_snapshot_and_rotation(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, keep=2, compact_every=3)
+    for i in range(3):
+        j.append("submitted", ts=float(i), job=f"job-{i:04d}", spec="s")
+    assert j.compaction_due
+    j.compact({"next_id": 3, "jobs": {}}, ts=3.0)
+    assert not j.compaction_due
+    live = read_journal(path)
+    assert [r["event"] for r in live.records] == ["snapshot"]
+    assert live.records[0]["state"]["next_id"] == 3
+    # The pre-compaction history rotated to .1, intact.
+    rot = read_journal(path + ".1")
+    assert [r["event"] for r in rot.records] == ["submitted"] * 3
+    # seq is contiguous across the compaction boundary.
+    assert live.records[0]["seq"] == 4
+
+
+def test_replay_state_folds_snapshot_and_events():
+    records = []
+
+    def rec(event, **kw):
+        r = {"v": 1, "seq": len(records) + 1, "event": event, **kw}
+        records.append(r)
+        return r
+
+    rec("submitted", ts=1.0, job="job-0001", spec="2pc:3",
+        max_seconds=60.0, idempotency_key="k1", dir="s/job-0001")
+    rec("admitted", ts=1.0, job="job-0001", lint_ok=None)
+    rec("started", ts=2.0, job="job-0001", attempt=0, engine="xla", pid=999)
+    rec("breaker_tripped", ts=3.0, consecutive=3)
+    rec("completed", ts=4.0, job="job-0001", status="done", error=None,
+        result={"generated": 10, "unique": 5})
+    state = _replay_state(records)
+    assert state["breaker"] == "open"
+    assert state["idem"] == {"k1": "job-0001"}
+    job = state["jobs"]["job-0001"]
+    assert job["status"] == "done" and job["completed_unix_ts"] == 4.0
+    assert job["result"]["generated"] == 10
+    assert state["counters"]["jobs_done"] == 1
+    assert state["counters"]["breaker_trips"] == 1
+    assert state["last_ts"] == 4.0
+
+
+def test_harness_schedule_and_faults_are_seed_deterministic():
+    """`tools/service_chaos.py --seed N` is reproducible: the submission
+    schedule and the fault plan are pure functions of the seed (the full
+    journal-event-sequence pin is the harness's own --check-repro)."""
+    sc = _harness()
+    assert sc.build_schedule(7, 3, 240.0) == sc.build_schedule(7, 3, 240.0)
+    assert sc.build_schedule(7, 3, 240.0) != sc.build_schedule(8, 3, 240.0)
+    for scenario in ("kill", "die", "torn"):
+        assert sc.fault_plan(7, scenario) == sc.fault_plan(7, scenario)
+    # Golden values pin CROSS-PROCESS stability (a per-process
+    # within-run comparison would be blind to PYTHONHASHSEED-style
+    # randomization — the bug the crc32 seed derivation fixed).
+    assert sc.fault_plan(42, "kill") == {"kill_after_s": 4.861}
+    assert sc.fault_plan(42, "die") == {"die_at_record": 9}
+    assert sc.fault_plan(42, "torn") == {"torn_at_record": 6}
+
+
+# --- the chaos layer --------------------------------------------------------
+
+
+def test_chaos_off_is_a_noop():
+    """The zero-overhead-off pin (like the obs NULL_TRACER guard): with
+    STPU_CHAOS unset nothing is parsed, no plan exists, and every hook
+    call is a fast None."""
+    assert chaos.fire("journal.torn", size=100) is None
+    assert chaos.fire("supervise.wedge") is None
+    assert not chaos.active()
+    assert chaos._PLAN is None  # no ChaosPlan was ever constructed
+
+
+def test_chaos_plan_parse_and_triggers():
+    plan = chaos.ChaosPlan("seed=9;a.b@n=2:at=17,mode=x;c.d@p=0.5;e.f")
+    assert plan.seed == 9
+    # @n=K: exactly the K-th invocation.
+    assert plan.fire("a.b") is None
+    assert plan.fire("a.b") == {"at": 17, "mode": "x"}
+    assert plan.fire("a.b") is None
+    # no trigger: every invocation.
+    assert plan.fire("e.f") == {}
+    assert plan.fire("e.f") == {}
+    # unknown point: never.
+    assert plan.fire("nope") is None
+    # @p=F: seeded — two plans from the same spec agree exactly.
+    twin = chaos.ChaosPlan("seed=9;a.b@n=2:at=17,mode=x;c.d@p=0.5;e.f")
+    seq = [plan.fire("c.d") is not None for _ in range(32)]
+    twin_seq = [twin.fire("c.d") is not None for _ in range(32)]
+    assert seq == twin_seq and True in seq and False in seq
+    # default `at` for torn faults is seeded from ctx size.
+    p2 = chaos.ChaosPlan("seed=3;t.x")
+    inj = p2.fire("t.x", size=50)
+    assert 1 <= inj["at"] < 50
+    with pytest.raises(ValueError):
+        chaos.ChaosPlan("bad clause@@")
+
+
+def test_chaos_supervise_wedge_verdict(tmp_path):
+    """A scripted wedge verdict kills the worker group and classifies as
+    wedged — the breaker/quarantine evidence path, no SIGSTOP needed."""
+    import sys
+
+    from stateright_tpu.supervise import run_worker
+
+    chaos.install("supervise.wedge@n=2")
+    res = run_worker(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        poll_s=0.1,
+        timeout_s=30.0,
+    )
+    assert res.killed == "chaos: simulated wedge verdict"
+    assert res.wedged and not res.crashed
+    assert res.seconds < 10.0
+
+
+def test_chaos_checkpoint_torn_falls_back_a_rotation(tmp_path):
+    """checkpoint.torn tears the live rotation at byte K after the
+    atomic replace; latest_valid_checkpoint skips it (typed) and lands
+    on the previous rotation — the designed fallback, now scriptable."""
+    from stateright_tpu.checkpoint import (
+        CheckpointCorrupt,
+        latest_valid_checkpoint,
+        load_checkpoint,
+    )
+    from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+
+    ck = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(frontier_capacity=1 << 9, table_capacity=1 << 12)
+    )
+    ck.join()
+    path = str(tmp_path / "ck.npz")
+    ck.save_checkpoint(path, keep=2)
+    chaos.install("checkpoint.torn@n=1:at=40")
+    ck.save_checkpoint(path, keep=2)  # live file torn, .1 intact
+    chaos.install(None)
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(path)
+    assert latest_valid_checkpoint(path) == path + ".1"
+
+
+def test_chaos_lint_timeout_fails_open(tmp_path):
+    """lint.timeout simulates the admission-lint subprocess timing out:
+    the job admits fail-open with ok=None and lint_errors counted — the
+    blind-gate path, scriptable without a 240s wait."""
+    svc = CheckerService(_config(
+        tmp_path, max_inflight=0, admission_lint=True,
+        chaos="lint.timeout@n=1",
+    ))
+    try:
+        job = svc.submit("2pc:3")
+        assert job.lint["ok"] is None
+        assert any("TimeoutExpired" in e for e in job.lint["errors"])
+        assert svc.gauges()["lint_errors"] == 1
+    finally:
+        svc.close()
+
+
+def test_chaos_worker_points_map_to_job_flags(tmp_path):
+    """worker.die/worker.freeze fire per SUBMIT (@n counts admissions)
+    and land as the matching job-level chaos flags with the exactly-once
+    marker armed by default."""
+    svc = CheckerService(_config(
+        tmp_path, max_inflight=0,
+        chaos="worker.die@n=2:depth=5;worker.freeze@n=1:depth=4,once=0",
+    ))
+    try:
+        first = svc.submit("2pc:3")
+        second = svc.submit("2pc:3")
+        assert first.chaos == {"freeze_at_depth": 4}  # once=0: no marker
+        assert second.chaos["die_at_depth"] == 5
+        assert second.chaos["marker"].startswith(second.dir)
+    finally:
+        svc.close()
+
+
+# --- restart recovery (journal-driven; no workers) --------------------------
+
+
+def _disarmed(tmp_path, **kw):
+    """A service whose scheduler can never start a worker
+    (max_inflight=0): admission + journal + recovery accounting only."""
+    return CheckerService(_config(tmp_path, max_inflight=0, **kw))
+
+
+def test_recovery_restores_done_jobs_and_idempotency(tmp_path):
+    svc = _disarmed(tmp_path)
+    job = svc.submit("2pc:3", idempotency_key="alpha", max_seconds=60.0)
+    # Settle it as done the way the service would (under the lock).
+    with svc._cond:
+        job.status = "done"
+        job.completed_unix_ts = time.time()
+        job.result = {"generated": 1146, "unique": 288, "max_depth": 11,
+                      "seconds": 1.0}
+        svc._counters.inc("jobs_done")
+        svc._jlog("completed", job=job.id, status="done", error=None,
+                  result=job.result)
+    svc.close()
+
+    svc2 = _disarmed(tmp_path)
+    try:
+        rec = svc2.gauges()["journal"]["recovery"]
+        assert rec["records_replayed"] >= 3 and rec["torn"] is None
+        restored = svc2.job(job.id)
+        assert restored.status == "done" and restored.recovered
+        assert restored.result["generated"] == 1146
+        # Idempotent resubmission after restart: the SAME job comes back,
+        # nothing is re-run, the dedupe is counted.
+        again = svc2.submit("2pc:3", idempotency_key="alpha")
+        assert again is restored
+        assert svc2.gauges()["idem_dedups"] == 1
+        assert svc2.gauges()["jobs_recovered"] == 1
+    finally:
+        svc2.close()
+
+
+def test_recovery_requeues_inflight_and_charges_budget(tmp_path):
+    """An in-flight job requeues on restart with the wall-clock it had
+    already spent charged (journal last-ts bounds 'alive until here')."""
+    svc = _disarmed(tmp_path)
+    job = svc.submit("2pc:3", idempotency_key="b", max_seconds=500.0)
+    with svc._cond:
+        job.status = "running"
+        svc._jlog("started", job=job.id, attempt=0, engine="xla",
+                  resumed_from=None, pid=None)
+        time.sleep(1.1)
+        svc._jlog("breaker_closed")  # any later record advances last_ts
+    svc.close()
+
+    svc2 = _disarmed(tmp_path)
+    try:
+        restored = svc2.job(job.id)
+        assert restored.status == "queued"
+        assert restored.consumed_s >= 1.0
+        rec = svc2.gauges()["journal"]["recovery"]
+        assert rec["jobs_requeued"] == 1
+    finally:
+        svc2.close()
+
+
+def test_recovery_expired_budget_fails_typed_not_rerun(tmp_path):
+    """A job whose budget was already spent when the pool died must fail
+    typed at recovery — never burn a fresh budget re-running."""
+    svc = _disarmed(tmp_path)
+    job = svc.submit("2pc:3", idempotency_key="c", max_seconds=0.5)
+    with svc._cond:
+        job.status = "running"
+        svc._jlog("started", job=job.id, attempt=0, engine="xla",
+                  resumed_from=None, pid=None)
+        time.sleep(1.1)
+        svc._jlog("breaker_closed")
+    svc.close()
+
+    svc2 = _disarmed(tmp_path)
+    try:
+        restored = svc2.job(job.id)
+        assert restored.status == "failed"
+        assert "budget exhausted" in restored.error
+        assert "before the restart" in restored.error
+        assert restored.attempts == []  # never re-run
+        # The typed failure is itself journaled: a THIRD incarnation
+        # restores it terminal without reconsidering.
+        svc2.close()
+        svc3 = _disarmed(tmp_path)
+        assert svc3.job(job.id).status == "failed"
+        assert svc3.job(job.id).attempts == []
+        svc3.close()
+    except BaseException:
+        svc2.close()
+        raise
+
+
+def test_recovery_torn_tail_replays_prefix_and_amputates(tmp_path):
+    """Service-level torn-tail recovery: truncate the live journal at a
+    random byte inside the LAST record; the restart replays everything
+    before it, reports the torn tail, and recompacts so the journal is
+    clean again."""
+    svc = _disarmed(tmp_path)
+    svc.submit("2pc:3", idempotency_key="t1", max_seconds=60.0)
+    svc.submit("2pc:3", idempotency_key="t2", max_seconds=60.0)
+    svc.close()
+    jpath = os.path.join(svc._cfg.run_dir, "journal.jsonl")
+    data = open(jpath, "rb").read()
+    last_line_start = data[:-1].rfind(b"\n") + 1
+    cut = random.Random(7).randint(last_line_start + 1, len(data) - 2)
+    with open(jpath, "wb") as fh:
+        fh.write(data[:cut])
+
+    svc2 = _disarmed(tmp_path)
+    try:
+        rec = svc2.gauges()["journal"]["recovery"]
+        assert rec["torn"] is not None
+        # Job t1 replayed fully; t2's admitted event was the torn record
+        # or survived — either way the clean prefix restored exactly.
+        assert "job-0001" in {j.id for j in svc2.jobs()}
+        # Recompaction amputated the torn bytes: the live journal reads
+        # clean end to end now.
+        assert read_journal(jpath).torn is None
+    finally:
+        svc2.close()
+
+
+def test_recovery_restores_open_breaker_and_reprobes_now(tmp_path):
+    """A restart must not forget an open breaker — and the restored-open
+    breaker re-probes IMMEDIATELY (not an interval later), so the first
+    job after a restart never goes straight at a wedged device."""
+    import sys
+
+    svc = _disarmed(tmp_path)
+    with svc._cond:
+        svc._breaker = "open"
+        svc._breaker_opened_unix_ts = time.time()
+        svc._consecutive_wedges = 3
+        svc._jlog("breaker_tripped", consecutive=3)
+    svc.close()
+
+    # probe_auto on, instant-success probe, LONG interval: only the
+    # immediate restart probe can close it within the poll window.
+    svc2 = CheckerService(_config(
+        tmp_path, max_inflight=0, probe_auto=True,
+        probe_interval_s=3600.0,
+        probe_argv=[sys.executable, "-c", "pass"],
+    ))
+    try:
+        deadline = time.monotonic() + 30.0
+        while svc2.degraded and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not svc2.degraded
+        g = svc2.gauges()
+        assert g["breaker_closes"] == 1 and g["device_probes"] == 1
+        # The close is journaled: a further restart stays closed.
+    finally:
+        svc2.close()
+    svc3 = _disarmed(tmp_path, probe_auto=False)
+    assert svc3.gauges()["breaker"]["state"] == "closed"
+    svc3.close()
+
+
+def test_artifact_sweep_reclaims_complete_jobs(tmp_path):
+    """Journal-complete jobs' run-dir artifacts are swept past the
+    retention; the pool gauge records it."""
+    svc = _disarmed(tmp_path, artifact_retention_s=0.0)
+    job = svc.submit("2pc:3", idempotency_key="s1", max_seconds=60.0)
+    for name in ("hb.json", "trace.jsonl", "ck.npz", "worker0.out"):
+        with open(os.path.join(job.dir, name), "w") as fh:
+            fh.write("x")
+    with svc._cond:
+        job.status = "done"
+        job.completed_unix_ts = time.time() - 10.0
+        job.result = {"generated": 1, "unique": 1}
+        svc._jlog("completed", job=job.id, status="done", error=None,
+                  result=job.result)
+        svc._sweep_artifacts()
+    assert not os.path.isdir(job.dir)
+    assert svc.gauges()["artifacts_swept"] == 1
+    # Sweeping is idempotent and the journal survives it.
+    with svc._cond:
+        svc._sweep_artifacts()
+    assert svc.gauges()["artifacts_swept"] == 1
+    svc.close()
+    svc2 = _disarmed(tmp_path)
+    assert svc2.job(job.id).status == "done"
+    svc2.close()
+
+
+# --- restart drills (the real service, killed for real) ---------------------
+
+
+def _drill_schedule(idem, specs=("2pc:3",)):
+    return {
+        "jobs": [
+            {"idem": f"{idem}-{i}", "spec": spec, "delay_s": 0.2 * i,
+             "max_seconds": 240.0}
+            for i, spec in enumerate(specs)
+        ]
+    }
+
+
+def test_smoke_service_restart_resume(tmp_path):
+    """The <30s tier-0 restart drill (tools/smoke.sh): the service
+    SIGKILLs itself right after journaling `started` (deterministic:
+    journal.die@n=3), the restart replays the journal, kills the
+    orphaned worker, requeues, and the job completes exactly once with
+    exact pinned counts."""
+    sc = _harness()
+    run_dir = str(tmp_path / "drill")
+    os.makedirs(run_dir)
+    schedule = _drill_schedule("drill")
+    sp = os.path.join(run_dir, "schedule.json")
+    with open(sp, "w") as fh:
+        json.dump(schedule, fh)
+    rc = sc.run_incarnation(
+        run_dir, sp, chaos="seed=1;journal.die@n=3", wait_s=120.0
+    )
+    assert rc == -9  # died by its own injected SIGKILL
+    rc = sc.run_incarnation(run_dir, sp, wait_s=120.0)
+    assert rc == 0
+    inv = sc.check_invariant(run_dir, schedule, None)
+    assert inv["ok"], inv["problems"]
+    with open(os.path.join(run_dir, "driver_results.json")) as fh:
+        results = json.load(fh)["jobs"]
+    got = results["drill-0"]
+    assert got["status"] == "done"
+    assert (got["result"]["generated"], got["result"]["unique"]) == PINNED_2PC3
+    slo = sc.slo_stats(run_dir)
+    assert slo["journal"]["records_replayed"] == 3
+    assert slo["journal"]["jobs_requeued"] == 1
+    # The orphaned first worker was killed by journaled pid before the
+    # job was rescheduled (exactly-once depends on it).
+    assert slo["journal"]["orphans_killed"] in (0, 1)
+
+
+@pytest.fixture(scope="module")
+def chaos_reference(tmp_path_factory):
+    """One undisturbed baseline run of the seeded 3-job schedule — the
+    ground truth both convergence pins compare against bit-for-bit."""
+    sc = _harness()
+    base = str(tmp_path_factory.mktemp("chaos"))
+    schedule = sc.build_schedule(42, 3, 240.0)
+    rep = sc.run_scenario("baseline", 42, schedule, base, reference=None)
+    assert rep["ok"], rep["problems"]
+    ref = sc.reference_counts(os.path.join(base, "baseline"), schedule)
+    return sc, base, schedule, ref
+
+
+@pytest.mark.slow
+def test_chaos_pin_service_sigkill_converges(chaos_reference):
+    """ISSUE 12 acceptance: SIGKILL the CheckerService process at a
+    seeded random point of a 3-concurrent-job schedule, restart from the
+    same run dir — every job completes exactly once, counts bit-identical
+    to the undisturbed run."""
+    sc, base, schedule, ref = chaos_reference
+    rep = sc.run_scenario(
+        "kill", 42, schedule, base, reference=ref, max_inflight=2
+    )
+    assert rep["ok"], rep["problems"]
+    assert rep["turnaround_s"]["n"] == 3
+
+
+@pytest.mark.slow
+def test_chaos_pin_torn_journal_converges(chaos_reference):
+    """Same schedule with journal-append torn-tail injection: the crash
+    lands MID-append, the restart recovers the typed torn tail and still
+    converges exactly-once, bit-identical."""
+    sc, base, schedule, ref = chaos_reference
+    rep = sc.run_scenario(
+        "torn", 42, schedule, base, reference=ref, max_inflight=2
+    )
+    assert rep["ok"], rep["problems"]
+    assert rep["journal"]["torn"] is not None  # the tear really landed

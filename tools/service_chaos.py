@@ -1,0 +1,640 @@
+#!/usr/bin/env python
+"""Chaos/load harness for the durable CheckerService (ROADMAP item 3c).
+
+Drives ONE pool — run in a killable child process — through a seeded
+schedule of concurrent submissions, injected faults
+(``stateright_tpu/chaos.py``), service SIGKILLs, and restarts over the
+same run dir, then asserts the invariant that matters:
+
+    every admitted job eventually completes EXACTLY ONCE, with
+    generated/unique/discovery counts bit-identical to an undisturbed
+    run of the same schedule.
+
+and reports SLO-style measurements — admission latency, Retry-After
+accuracy, p50/p99 job turnaround — as one JSON line on stdout, banked
+atomically at ``runs/service_chaos.json`` (bench.py folds it into
+``bench_detail.json`` as ``journal`` provenance).
+
+Scenarios (``--scenario``):
+
+- ``baseline``  — undisturbed run; its per-spec counts are the ground
+  truth the others compare against (it always runs first).
+- ``kill``      — SIGKILL the service's process group at a seeded
+  wall-clock point, restart over the same run dir (blindly resubmitting
+  the whole schedule under the same idempotency keys — the restart
+  loop's contract), repeat up to ``--max-restarts``, final pass clean.
+- ``die``       — deterministic crash: the first incarnation carries
+  ``journal.die@n=K`` (SIGKILL itself right after the K-th journal
+  record), so the restart drill is bit-reproducible.
+- ``torn``      — like ``die`` but ``journal.torn@n=K``: the crash
+  happens MID-append, leaving a torn journal tail the restart must
+  recover from (typed, minus the torn record).
+- ``all``       — baseline + kill + torn (the acceptance sweep).
+
+Everything the parent does is jax-free; model work happens in the
+service's worker subprocesses (CPU-pinned via ``ServiceConfig
+(platform="cpu")`` by default — the sitecustomize gotcha means a bare
+``JAX_PLATFORMS=cpu`` env cannot, see CLAUDE.md).
+
+Reproducibility: the fault schedule (submission order/delays, kill
+point, torn/die record index) is a pure function of ``--seed``.
+``--check-repro`` runs the schedule twice serially (``max_inflight=1``)
+through fresh run dirs and diffs the two journals' event sequences
+(event names + job ids, timestamps and pids masked) — same seed, same
+sequence.
+
+Usage::
+
+    python tools/service_chaos.py --seed 42                # all scenarios
+    python tools/service_chaos.py --seed 7 --scenario kill --jobs 3
+    python tools/service_chaos.py --seed 7 --check-repro
+
+``tools/tpu_watch.sh service_chaos`` is the watcher stage alias; the
+<30s restart drill in ``tools/smoke.sh`` and the <60s chaos pins in
+``tests/test_service_durability.py`` drive these scenarios through the
+same entry points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+RUNS = os.path.join(REPO, "runs")
+
+#: The schedule's spec pool: tiny shipped models (seconds per worker on
+#: CPU with a warm compile cache) with exact full-coverage counts.
+SPEC_POOL = ("2pc:3", "increment-lock:3", "abd:2")
+
+RESULT_KEYS = ("generated", "unique", "max_depth", "discoveries")
+
+
+def log(msg: str) -> None:
+    print(f"[service_chaos] {msg}", file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# Seeded schedule
+# --------------------------------------------------------------------------
+
+
+def build_schedule(seed: int, jobs: int, max_seconds: float) -> Dict[str, Any]:
+    """The seeded submission schedule: pure function of (seed, jobs)."""
+    import random
+
+    rng = random.Random(seed)
+    return {
+        "seed": seed,
+        "jobs": [
+            {
+                "idem": f"chaos-{seed}-{i}",
+                "spec": rng.choice(SPEC_POOL),
+                "delay_s": round(rng.uniform(0.0, 1.5), 3),
+                "max_seconds": max_seconds,
+            }
+            for i in range(jobs)
+        ],
+    }
+
+
+def fault_plan(seed: int, scenario: str) -> Dict[str, Any]:
+    """The seeded fault schedule for one scenario (reported in the SLO
+    line so a rerun is auditable). crc32, not hash(): the builtin is
+    PYTHONHASHSEED-randomized per process, which would silently break
+    the cross-run reproducibility this function promises."""
+    import random
+    import zlib
+
+    rng = random.Random((seed << 8) ^ zlib.crc32(scenario.encode()))
+    if scenario == "kill":
+        return {"kill_after_s": round(rng.uniform(2.0, 9.0), 3)}
+    if scenario == "die":
+        return {"die_at_record": rng.randint(3, 10)}
+    if scenario == "torn":
+        return {"torn_at_record": rng.randint(3, 10)}
+    return {}
+
+
+# --------------------------------------------------------------------------
+# Serve mode: one service incarnation in THIS process (run as a child)
+# --------------------------------------------------------------------------
+
+
+def serve(args: argparse.Namespace) -> int:
+    """One service incarnation: recover (if the run dir has a journal),
+    resubmit the whole schedule idempotently, wait for every job, write
+    driver_results.json. Killable at any instant — that is the point."""
+    from stateright_tpu.service import CheckerService, ServiceConfig
+
+    with open(args.schedule) as fh:
+        schedule = json.load(fh)
+    cfg = ServiceConfig(
+        run_dir=args.run_dir,
+        platform="cpu",
+        max_inflight=args.max_inflight,
+        max_queue=max(8, len(schedule["jobs"]) + 2),
+        # Every restart recovery compacts once (one rotation per
+        # incarnation); the exactly-once audit (check_invariant) reads
+        # the FULL event history across rotations, so the keep bound
+        # must out-last the restart loop (max_restarts <= 4) or early
+        # incarnations' completed events would rotate away and read as
+        # false invariant failures.
+        journal_keep=12,
+        stall_s=8.0,
+        startup_grace_s=240.0,
+        poll_s=0.2,
+        backoff_s=0.1,
+        probe_auto=False,
+        admission_lint=False,
+        chaos=args.chaos or None,
+    )
+    svc = CheckerService(cfg)
+    svc.log = log
+    stats_path = os.path.join(args.run_dir, "admission_stats.jsonl")
+    t0 = time.monotonic()
+    jobs = []
+    with open(stats_path, "a") as stats:
+        for entry in schedule["jobs"]:
+            delay = entry["delay_s"] - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            t = time.monotonic()
+            job = svc.submit(
+                entry["spec"],
+                max_seconds=entry["max_seconds"],
+                idempotency_key=entry["idem"],
+            )
+            stats.write(
+                json.dumps(
+                    {
+                        "idem": entry["idem"],
+                        "job": job.id,
+                        "latency_ms": round(
+                            (time.monotonic() - t) * 1e3, 3
+                        ),
+                        "deduped": job.recovered,
+                    }
+                )
+                + "\n"
+            )
+            stats.flush()
+            jobs.append((entry, job))
+    retry_stats = (
+        _overload_probe(svc, schedule) if args.overload else None
+    )
+    if not svc.wait_all(timeout=args.wait_s):
+        log(f"wait_all timed out after {args.wait_s}s: {svc.gauges()}")
+        svc.close()
+        return 4
+    out = {
+        "jobs": {
+            entry["idem"]: {
+                "id": job.id,
+                "spec": entry["spec"],
+                "status": job.status,
+                "error": job.error,
+                "recovered": job.recovered,
+                "requeues": job.requeues,
+                "result": (
+                    {k: job.result.get(k) for k in RESULT_KEYS}
+                    if job.result
+                    else None
+                ),
+            }
+            for entry, job in jobs
+        },
+        "gauges": svc.gauges(),
+        "retry_after": retry_stats,
+    }
+    svc.close()
+    tmp = os.path.join(args.run_dir, "driver_results.json.tmp")
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, os.path.join(args.run_dir, "driver_results.json"))
+    return 0
+
+
+def _overload_probe(svc, schedule) -> Dict[str, Any]:
+    """Retry-After accuracy: push the queue past its cap, record the
+    typed hint, retry after (a capped fraction of) it — ``accurate``
+    counts hints that were sufficient."""
+    from stateright_tpu.service import AdmissionError
+
+    spec = schedule["jobs"][0]["spec"]
+    observed = accurate = 0
+    hints: List[float] = []
+    for i in range(svc._cfg.max_queue + 2):
+        try:
+            svc.submit(spec, max_seconds=schedule["jobs"][0]["max_seconds"])
+        except AdmissionError as e:
+            if e.retry_after_s is None:
+                continue
+            observed += 1
+            hints.append(e.retry_after_s)
+            time.sleep(min(e.retry_after_s, 15.0))
+            try:
+                svc.submit(
+                    spec, max_seconds=schedule["jobs"][0]["max_seconds"]
+                )
+                accurate += 1
+            except AdmissionError:
+                pass
+            break
+    return {"observed": observed, "accurate": accurate, "hints_s": hints}
+
+
+# --------------------------------------------------------------------------
+# Parent: incarnation driver + invariant checks
+# --------------------------------------------------------------------------
+
+
+def run_incarnation(
+    run_dir: str,
+    schedule_path: str,
+    *,
+    kill_after_s: Optional[float] = None,
+    chaos: Optional[str] = None,
+    max_inflight: int = 2,
+    overload: bool = False,
+    wait_s: float = 300.0,
+) -> int:
+    """Spawn one ``--serve`` child (its own process group) and either let
+    it finish or SIGKILL the whole group after ``kill_after_s`` — the
+    harness's service-crash primitive. Returns the child's rc, or -9."""
+    argv = [
+        sys.executable, os.path.abspath(__file__), "--serve",
+        "--run-dir", run_dir, "--schedule", schedule_path,
+        "--max-inflight", str(max_inflight),
+        "--wait-s", str(wait_s),
+    ]
+    if chaos:
+        argv += ["--chaos", chaos]
+    if overload:
+        argv += ["--overload"]
+    proc = subprocess.Popen(argv, start_new_session=True)
+    if kill_after_s is None:
+        try:
+            return proc.wait(timeout=wait_s + 60.0)
+        except subprocess.TimeoutExpired:
+            log(f"incarnation overran {wait_s + 60.0:.0f}s; killing group")
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            proc.wait(timeout=10.0)
+            return 124
+    try:
+        rc = proc.wait(timeout=kill_after_s)
+        return rc  # finished before the kill point
+    except subprocess.TimeoutExpired:
+        pass
+    log(f"SIGKILL service incarnation (pid {proc.pid})")
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except OSError:
+        proc.kill()
+    proc.wait(timeout=10.0)
+    return -9
+
+
+def journal_history(run_dir: str) -> List[Dict[str, Any]]:
+    """Every journal record across the compaction rotations, oldest
+    first — each event appears exactly once (compaction rewrites the
+    live log as a snapshot; rotations keep the raw history)."""
+    from stateright_tpu.service import read_journal
+
+    base = os.path.join(run_dir, "journal.jsonl")
+    paths = []
+    i = 1
+    while os.path.exists(f"{base}.{i}"):
+        paths.append(f"{base}.{i}")
+        i += 1
+    paths.reverse()
+    if os.path.exists(base):
+        paths.append(base)
+    records: List[Dict[str, Any]] = []
+    for p in paths:
+        records.extend(read_journal(p).records)
+    return records
+
+
+def event_signature(records: List[Dict[str, Any]]) -> List[str]:
+    """The reproducibility projection: event names + job ids, with
+    timestamps/pids/digests/durations masked."""
+    return [
+        f"{r['event']}:{r.get('job', '-')}"
+        for r in records
+        if r["event"] not in ("snapshot", "recovered")
+    ]
+
+
+def check_invariant(
+    run_dir: str, schedule: Dict[str, Any], reference: Optional[dict]
+) -> Dict[str, Any]:
+    """The acceptance invariant: every scheduled job present, done,
+    completed exactly once across the whole journal history, counts
+    bit-identical to the reference (per spec)."""
+    with open(os.path.join(run_dir, "driver_results.json")) as fh:
+        results = json.load(fh)["jobs"]
+    problems: List[str] = []
+    history = journal_history(run_dir)
+    done_events: Dict[str, int] = {}
+    for r in history:
+        if r["event"] == "completed" and r.get("status") == "done":
+            done_events[r["job"]] = done_events.get(r["job"], 0) + 1
+    for jid, n in done_events.items():
+        if n > 1:
+            problems.append(f"{jid} completed done {n} times")
+    for entry in schedule["jobs"]:
+        got = results.get(entry["idem"])
+        if got is None:
+            problems.append(f"{entry['idem']} missing from results")
+            continue
+        if got["status"] != "done":
+            problems.append(
+                f"{entry['idem']} status={got['status']} ({got['error']})"
+            )
+            continue
+        if done_events.get(got["id"], 0) != 1:
+            problems.append(
+                f"{entry['idem']} ({got['id']}) has "
+                f"{done_events.get(got['id'], 0)} done events in the journal"
+            )
+        if reference is not None:
+            want = reference[entry["spec"]]
+            have = got["result"]
+            for key in RESULT_KEYS:
+                if have.get(key) != want.get(key):
+                    problems.append(
+                        f"{entry['idem']} {key} {have.get(key)!r} != "
+                        f"reference {want.get(key)!r}"
+                    )
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "journal_records": len(history),
+    }
+
+
+def _percentiles(values: List[float]) -> Optional[Dict[str, float]]:
+    if not values:
+        return None
+    vs = sorted(values)
+
+    def pct(p: float) -> float:
+        return vs[min(len(vs) - 1, int(round(p * (len(vs) - 1))))]
+
+    return {
+        "p50": round(pct(0.50), 3),
+        "p99": round(pct(0.99), 3),
+        "max": round(vs[-1], 3),
+        "n": len(vs),
+    }
+
+
+def slo_stats(run_dir: str) -> Dict[str, Any]:
+    """Admission latency (appended live by every incarnation, so kills
+    lose nothing) + per-job turnaround from the journal history."""
+    latencies: List[float] = []
+    stats_path = os.path.join(run_dir, "admission_stats.jsonl")
+    if os.path.exists(stats_path):
+        with open(stats_path) as fh:
+            for line in fh:
+                try:
+                    latencies.append(json.loads(line)["latency_ms"])
+                except (json.JSONDecodeError, KeyError):
+                    pass
+    submitted: Dict[str, float] = {}
+    completed: Dict[str, float] = {}
+    recovery = None
+    for r in journal_history(run_dir):
+        if r["event"] == "submitted":
+            submitted.setdefault(r["job"], r["ts"])
+        elif r["event"] == "completed" and r.get("status") == "done":
+            completed[r["job"]] = r["ts"]
+        elif r["event"] == "recovered":
+            recovery = {
+                k: r.get(k)
+                for k in (
+                    "records_replayed", "jobs_recovered", "jobs_requeued",
+                    "jobs_readopted", "orphans_killed", "torn",
+                )
+            }
+    turnaround = [
+        completed[j] - submitted[j] for j in completed if j in submitted
+    ]
+    return {
+        "admission_latency_ms": _percentiles(latencies),
+        "turnaround_s": _percentiles(turnaround),
+        "journal": recovery,
+    }
+
+
+# --------------------------------------------------------------------------
+# Scenarios
+# --------------------------------------------------------------------------
+
+
+def run_scenario(
+    name: str,
+    seed: int,
+    schedule: Dict[str, Any],
+    base_dir: str,
+    *,
+    reference: Optional[dict],
+    max_inflight: int = 2,
+    max_restarts: int = 4,
+    overload: bool = False,
+    wait_s: float = 300.0,
+) -> Dict[str, Any]:
+    """One scenario end to end; returns its report (and, for baseline,
+    the reference counts the others compare against)."""
+    run_dir = os.path.join(base_dir, name)
+    os.makedirs(run_dir, exist_ok=True)
+    schedule_path = os.path.join(run_dir, "schedule.json")
+    with open(schedule_path, "w") as fh:
+        json.dump(schedule, fh)
+    faults = fault_plan(seed, name)
+    t0 = time.monotonic()
+    restarts = 0
+    kw = dict(max_inflight=max_inflight, overload=overload, wait_s=wait_s)
+    if name == "baseline":
+        rc = run_incarnation(run_dir, schedule_path, **kw)
+    elif name == "kill":
+        rc = run_incarnation(
+            run_dir, schedule_path,
+            kill_after_s=faults["kill_after_s"], **kw,
+        )
+        while rc != 0 and restarts < max_restarts:
+            restarts += 1
+            rc = run_incarnation(run_dir, schedule_path, **kw)
+    elif name in ("die", "torn"):
+        point = "journal.die" if name == "die" else "journal.torn"
+        n = faults.get("die_at_record") or faults.get("torn_at_record")
+        rc = run_incarnation(
+            run_dir, schedule_path,
+            chaos=f"seed={seed};{point}@n={n}", **kw,
+        )
+        while rc != 0 and restarts < max_restarts:
+            restarts += 1
+            rc = run_incarnation(run_dir, schedule_path, **kw)
+    else:
+        raise ValueError(f"unknown scenario {name!r}")
+    if rc != 0:
+        return {
+            "scenario": name, "ok": False, "rc": rc, "restarts": restarts,
+            "problems": [f"final incarnation rc={rc}"], "faults": faults,
+        }
+    invariant = check_invariant(
+        run_dir, schedule, None if name == "baseline" else reference
+    )
+    report = {
+        "scenario": name,
+        "ok": invariant["ok"],
+        "problems": invariant["problems"],
+        "faults": faults,
+        "restarts": restarts,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+        **slo_stats(run_dir),
+    }
+    if overload:
+        with open(os.path.join(run_dir, "driver_results.json")) as fh:
+            report["retry_after"] = json.load(fh).get("retry_after")
+    return report
+
+
+def reference_counts(run_dir: str, schedule: Dict[str, Any]) -> dict:
+    """spec -> result counts from the baseline scenario's results."""
+    with open(os.path.join(run_dir, "driver_results.json")) as fh:
+        results = json.load(fh)["jobs"]
+    out: dict = {}
+    for entry in schedule["jobs"]:
+        got = results[entry["idem"]]
+        if got["status"] != "done":
+            raise RuntimeError(
+                f"baseline job {entry['idem']} did not complete: "
+                f"{got['error']}"
+            )
+        out[entry["spec"]] = got["result"]
+    return out
+
+
+def check_repro(args: argparse.Namespace, base_dir: str) -> Dict[str, Any]:
+    """Same seed, twice, fresh dirs, serial pool: the journal event
+    sequences (timestamps masked) must be identical."""
+    schedule = build_schedule(args.seed, args.jobs, args.max_seconds)
+    sigs = []
+    for i in (1, 2):
+        run_dir = os.path.join(base_dir, f"repro{i}")
+        os.makedirs(run_dir, exist_ok=True)
+        sp = os.path.join(run_dir, "schedule.json")
+        with open(sp, "w") as fh:
+            json.dump(schedule, fh)
+        rc = run_incarnation(
+            run_dir, sp, max_inflight=1, wait_s=args.wait_s
+        )
+        if rc != 0:
+            return {"ok": False, "problems": [f"repro pass {i} rc={rc}"]}
+        sigs.append(event_signature(journal_history(run_dir)))
+    return {
+        "ok": sigs[0] == sigs[1],
+        "events": len(sigs[0]),
+        "problems": (
+            [] if sigs[0] == sigs[1] else [
+                f"event sequences diverge: {sigs[0]} != {sigs[1]}"
+            ]
+        ),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--jobs", type=int, default=3)
+    p.add_argument("--scenario", default="all",
+                   choices=("all", "baseline", "kill", "die", "torn"))
+    p.add_argument("--base-dir", default=None,
+                   help="scenario run dirs land here "
+                        "(default runs/service_chaos/seed<N>)")
+    p.add_argument("--max-seconds", type=float, default=240.0)
+    p.add_argument("--max-inflight", type=int, default=2)
+    p.add_argument("--max-restarts", type=int, default=4)
+    p.add_argument("--wait-s", type=float, default=300.0)
+    p.add_argument("--overload", action="store_true",
+                   help="probe Retry-After accuracy with a queue-full burst")
+    p.add_argument("--check-repro", action="store_true")
+    p.add_argument("--out", default=os.path.join(RUNS, "service_chaos.json"))
+    # serve mode (the killable child; internal)
+    p.add_argument("--serve", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--run-dir", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--schedule", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--chaos", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.serve:
+        return serve(args)
+
+    base_dir = args.base_dir or os.path.join(
+        RUNS, "service_chaos", f"seed{args.seed}"
+    )
+    os.makedirs(base_dir, exist_ok=True)
+    schedule = build_schedule(args.seed, args.jobs, args.max_seconds)
+    line: Dict[str, Any] = {
+        "tool": "service_chaos",
+        "seed": args.seed,
+        "jobs": args.jobs,
+        "specs": [j["spec"] for j in schedule["jobs"]],
+        "scenarios": {},
+        "ok": True,
+    }
+    if args.check_repro:
+        rep = check_repro(args, base_dir)
+        line["scenarios"]["repro"] = rep
+        line["ok"] = line["ok"] and rep["ok"]
+    else:
+        names = (
+            ["baseline", "kill", "torn"]
+            if args.scenario == "all"
+            else ["baseline"]
+            + ([args.scenario] if args.scenario != "baseline" else [])
+        )
+        reference = None
+        kw = dict(
+            max_inflight=args.max_inflight,
+            max_restarts=args.max_restarts,
+            wait_s=args.wait_s,
+        )
+        for name in names:
+            rep = run_scenario(
+                name, args.seed, schedule, base_dir,
+                reference=reference,
+                overload=args.overload and name == "baseline",
+                **kw,
+            )
+            line["scenarios"][name] = rep
+            line["ok"] = line["ok"] and rep["ok"]
+            if name == "baseline" and rep["ok"]:
+                reference = reference_counts(
+                    os.path.join(base_dir, "baseline"), schedule
+                )
+            elif name == "baseline":
+                break  # no ground truth; the comparisons are meaningless
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(line, fh, indent=1)
+    os.replace(tmp, args.out)
+    print(json.dumps(line))
+    return 0 if line["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,15 +1,18 @@
 #!/usr/bin/env bash
-# Tier-0 smoke: a <7-minute subset to run BEFORE the ~50-minute full
+# Tier-0 smoke: a <8-minute subset to run BEFORE the ~50-minute full
 # suite — the lint gate, the observability schemas (trace/heartbeat/
 # metrics/dispatch_log consumers parse these), one fused-vs-single
 # exactness pin (the engine's semantic contract), one packed-model
 # end-to-end check, a <30s kill-and-resume crash drill (SIGKILL a
 # supervised worker, resume from its auto-checkpoint, exact pinned
-# counts — the recovery stack's tier-0 proof), and the <30s SERVICE
+# counts — the recovery stack's tier-0 proof), the <30s SERVICE
 # crash drill (a CheckerService job SIGKILLed mid-superstep requeues,
 # resumes from its per-job checkpoint, exact counts + Chrome trace — the
-# multi-tenant pool's tier-0 proof). A red here means don't bother
-# starting the full run.
+# multi-tenant pool's tier-0 proof), and the <30s SERVICE RESTART drill
+# (the service process itself dies right after journaling `started`; the
+# restart replays the job journal, kills the orphaned worker, requeues,
+# and converges to exact counts — the durability tier's tier-0 proof).
+# A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
 set -euo pipefail
@@ -22,10 +25,11 @@ cd "$(dirname "$0")/.."
 mkdir -p runs
 timeout -k 5 60 python tools/stpu_lint.py --json-out runs/lint.json
 
-exec timeout -k 10 340 python -m pytest \
+exec timeout -k 10 380 python -m pytest \
   tests/test_obs.py \
   tests/test_fused_dispatch.py::test_fused_matches_single_full_coverage \
   tests/test_packed_increment.py \
   tests/test_supervise.py::test_smoke_kill_resume \
   tests/test_service.py::test_smoke_service_kill_resume \
+  tests/test_service_durability.py::test_smoke_service_restart_resume \
   -x -q -p no:cacheprovider "$@"

@@ -68,14 +68,20 @@ if [ ${#STAGES[@]} -eq 0 ]; then
   STAGES=("plan,7200,runs/tpu_plan.log,bash tools/tpu_plan.sh")
 fi
 
-# Built-in stage alias: a bare "soak_resume" expands to the SUPERVISED
+# Built-in stage aliases: a bare "soak_resume" expands to the SUPERVISED
 # rm=10 soak (tools/soak.py) — the worker auto-checkpoints and the soak's
 # own supervisor resumes it after a wedge, so this outer watcher only
 # backstops a dead supervisor. (soak.py reuses the stage's STPU_HEARTBEAT
 # for its worker, so hb_stale below still sees real engine liveness.)
+# A bare "service_chaos" expands to the seeded durable-service chaos
+# harness (tools/service_chaos.py: baseline + SIGKILL-restart + torn-
+# journal scenarios, exactly-once + bit-identical counts, SLO line to
+# runs/service_chaos.json — bench_detail's "journal" provenance).
 for i in "${!STAGES[@]}"; do
   if [ "${STAGES[$i]}" = "soak_resume" ]; then
     STAGES[$i]="soak_resume,14400,runs/soak_resume.log,python tools/soak.py --config rm10 --audit"
+  elif [ "${STAGES[$i]}" = "service_chaos" ]; then
+    STAGES[$i]="service_chaos,1800,runs/service_chaos.log,python tools/service_chaos.py --seed 42 --jobs 3"
   fi
 done
 
