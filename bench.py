@@ -202,6 +202,26 @@ def _journal_provenance() -> dict | None:
         return None
 
 
+def _regress_provenance() -> dict | None:
+    """The latest perf-regression verdict from runs/regress.json (written
+    by tools/bench_regress.py — the gate judging a fresh primary line
+    against the archived runs/archive/BENCH_r*.json trajectory), or None
+    when no verdict has been produced. Tolerant of a missing or empty
+    archive by construction: the gate itself reports the typed
+    "no_baseline" verdict there (fresh clones carry no trajectory), so
+    this hook never crashes the bench over absent history."""
+    try:
+        with open(os.path.join(RUNS, "regress.json")) as fh:
+            line = json.load(fh)
+        return {
+            "verdict": line.get("verdict"),
+            "platform": line.get("platform"),
+            "baseline": (line.get("baseline") or {}).get("best"),
+        }
+    except Exception:
+        return None
+
+
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
     os.makedirs(RUNS, exist_ok=True)
@@ -648,9 +668,12 @@ def _worker(platform: str) -> None:
                     "rm": rm,
                     # Obs artifacts of this run (docs/observability.md):
                     # the span JSONL (tools/roofline.py --measured reads
-                    # it) and the watchdog heartbeat, when enabled.
+                    # it), the watchdog heartbeat, and the metrics
+                    # time-series (roofline's fallback source when no
+                    # span trace exists), when enabled.
                     "trace": os.environ.get("STPU_TRACE") or None,
                     "heartbeat": os.environ.get("STPU_HEARTBEAT") or None,
+                    "metrics_series": os.environ.get("STPU_METRICS_TO") or None,
                     "metrics": checker.metrics(),
                     "table_capacity": checker._table.capacity,
                     "cand_ladder": checker._cand_ladder_k,
@@ -677,6 +700,13 @@ def _worker(platform: str) -> None:
                     # service_chaos sweep's journal verdicts — records
                     # replayed and jobs re-adopted across restarts.
                     "journal": _journal_provenance(),
+                    # Perf-regression provenance (tools/bench_regress.py):
+                    # the last gate verdict against the archived
+                    # trajectory, when one exists. The gate runs AFTER a
+                    # bench (it consumes this very file), so this records
+                    # the previous verdict — trajectory context, not this
+                    # run's judgment.
+                    "regress": _regress_provenance(),
                     # stpu-lint provenance (docs/static-analysis.md):
                     # the latest runs/lint.json verdict — True/False, or
                     # None when no lint artifact exists (run

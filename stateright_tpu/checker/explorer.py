@@ -12,9 +12,16 @@ demand-driven checker (``spawn_on_demand``) with a small HTTP API —
   checker to expand each child on demand (explorer.rs:209-312);
 - ``POST /.runtocompletion`` → unblocks the checker (explorer.rs:178-187) —
 
-plus the single-page UI in ``stateright_tpu/ui/`` (an original
-implementation; the reference vendors a Knockout.js app with the same HTTP
-contract). UI files are read from ``./ui/`` if present (dev mode, like
+plus the service/telemetry surface this framework adds on top of the
+reference contract: ``GET /.pool`` (full pool status), ``GET /.metrics``
+(OpenMetrics exposition of session + pool + every job;
+``stateright_tpu/obs/promexport.py``), ``GET /.jobs/{id}/metrics.json``
+(windowed metrics time-series) and ``GET /.jobs/{id}/trace.json``
+(Perfetto export), and ``GET /.dash`` — the live pool dashboard
+(``ui/dash.htm``; docs/observability.md "Dashboard") — plus the
+single-page UI in ``stateright_tpu/ui/`` (an original implementation;
+the reference vendors a Knockout.js app with the same HTTP contract). UI
+files are read from ``./ui/`` if present (dev mode, like
 explorer.rs:118-131) else from the installed package.
 
 The app logic lives in :class:`ExplorerApp`, framework-free and directly
@@ -29,12 +36,17 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path as FsPath
 from typing import Any, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 from ..core import Expectation
 from ..fingerprint import fingerprint
+from ..obs import heartbeat as hb_mod
+from ..obs import promexport
+from ..obs.timeseries import SCHEMA_VERSION
 from .path import Path
 
 _UI_DIR = FsPath(__file__).resolve().parent.parent / "ui"
@@ -42,7 +54,15 @@ _UI_FILES = {
     "/": ("index.htm", "text/html"),
     "/app.css": ("app.css", "text/css"),
     "/app.js": ("app.js", "text/javascript"),
+    "/.dash": ("dash.htm", "text/html"),
+    "/dash.js": ("dash.js", "text/javascript"),
 }
+
+#: Default/maximum rows a windowed series request returns (the dashboard
+#: polls with small windows; an unbounded ?n= must not stream a soak's
+#: whole rotation chain through one poll).
+_SERIES_WINDOW = 256
+_SERIES_WINDOW_MAX = 4096
 
 #: serde renders Rust unit variants with their name (explorer.rs:13 via
 #: lib.rs:317), and the UI switches on these strings (ui/app.js:38-43).
@@ -90,6 +110,14 @@ class ExplorerApp:
         self._lock = threading.Lock()
         self._service = service
         self._job = job
+        # Live metrics ring for the interactive session: batch jobs have
+        # a recorded metrics.jsonl under their job dir, but this app's
+        # own checker runs in-process — each /.jobs/{id}/metrics.json
+        # poll appends one live sample, so a polling dashboard builds the
+        # series it charts (docs/observability.md "Dashboard").
+        self._series: deque = deque(maxlen=_SERIES_WINDOW_MAX)
+        self._series_seq = 0
+        self._series_epoch = time.monotonic()
 
     # --- handlers ---------------------------------------------------------
 
@@ -116,6 +144,11 @@ class ExplorerApp:
                 # or None — so a wedged interactive session is diagnosable
                 # (and resumable) from the outside.
                 "last_checkpoint": getattr(checker, "_last_checkpoint", None),
+                # Liveness: seconds since this checker's heartbeat file
+                # was last rewritten (host-side mtime read), or None when
+                # the heartbeat protocol is off — a wedging session is
+                # visible from the status surface without tailing files.
+                "heartbeat_age_s": self._heartbeat_age(checker),
             }
             # Service client fields (additive — the pre-service keys above
             # are unchanged for existing consumers): this session's pool
@@ -260,7 +293,79 @@ class ExplorerApp:
         with open(path, "rb") as fh:
             return 200, fh.read()
 
+    def metrics_text(self) -> str:
+        """``GET /.metrics`` — the OpenMetrics exposition of this session
+        plus (when service-backed) the pool gauges and every pool job's
+        engine snapshot, labeled ``job``/``engine``/``dedup``
+        (``stateright_tpu/obs/promexport.py``; docs/observability.md
+        "/.metrics"). Counters match ``checker.metrics()`` exactly —
+        pinned by tests/test_promexport.py and the smoke stage's scrape."""
+        samples: List[promexport.Sample] = []
+        with self._lock:
+            own = self._checker.metrics()
+        own_label = self._job.id if self._job is not None else "interactive"
+        samples += promexport.engine_samples(own, {"job": own_label})
+        if self._service is not None:
+            samples += promexport.pool_samples(self._service.gauges())
+            for job in self._service.jobs():
+                if self._job is not None and job.id == self._job.id:
+                    continue  # this session's checker is already rendered
+                m = job.metrics()
+                if m is not None:
+                    samples += promexport.engine_samples(m, {"job": job.id})
+        return promexport.render_openmetrics(samples)
+
+    def job_metrics(self, job_id: str, window: Optional[int] = None) -> Tuple[int, Any]:
+        """``GET /.jobs/{id}/metrics.json`` — the job's windowed metrics
+        time-series as ``{"job", "window", "rows"}``, rows oldest first.
+        Batch jobs serve their recorded per-job ``metrics.jsonl``; this
+        session's own interactive checker serves a live ring that grows
+        one sample per poll (see ``__init__``)."""
+        # Clamp into [1, max]: a zero/negative ?n= must not bypass the
+        # window and stream a soak's whole rotation chain in one poll.
+        window = max(1, min(window or _SERIES_WINDOW, _SERIES_WINDOW_MAX))
+        if self._job is not None and job_id == self._job.id or (
+            self._service is None and job_id == "interactive"
+        ):
+            # Sample + append + snapshot under ONE lock hold: the server
+            # is threading, and concurrent polls racing the deque would
+            # tear the snapshot and interleave seq out of order.
+            with self._lock:
+                m = self._checker.metrics()
+                # Monotonic row seq (the recorder contract) — NOT the ring
+                # length, which pins at maxlen once the deque fills.
+                seq = self._series_seq
+                self._series_seq += 1
+                self._series.append(
+                    {
+                        "v": SCHEMA_VERSION,
+                        "unix_ts": time.time(),
+                        "t": round(time.monotonic() - self._series_epoch, 6),
+                        "seq": seq,
+                        "kind": "live",
+                        "metrics": m,
+                    }
+                )
+                rows = list(self._series)[-window:]
+            return 200, {"job": job_id, "window": window, "rows": rows}
+        if self._service is None:
+            return 404, "no service attached"
+        try:
+            rows = self._service.job_metrics_series(job_id, window=window)
+        except KeyError:
+            return 404, f"unknown job {job_id}"
+        if rows is None:
+            return 404, f"job {job_id} has no metrics series"
+        return 200, {"job": job_id, "window": window, "rows": rows}
+
     # --- helpers ----------------------------------------------------------
+
+    def _heartbeat_age(self, checker) -> Optional[float]:
+        hb = getattr(checker, "_heartbeat", None)
+        if hb is None:
+            return None
+        age = hb_mod.age_s(hb.path)
+        return None if age is None else round(age, 3)
 
     def _properties(self) -> List[Tuple[str, str, Optional[str]]]:
         """(expectation, name, encoded discovery path) triples
@@ -440,11 +545,25 @@ class _ExplorerHandler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(payload).encode(), "application/json")
 
     def do_GET(self):  # noqa: N802 (stdlib API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/.status":
             self._send_json(200, self.explorer_app.status())
+        elif path == "/.metrics":
+            body = self.explorer_app.metrics_text().encode()
+            self._send(200, body, promexport.CONTENT_TYPE)
         elif path == "/.pool":
             code, body = self.explorer_app.pool()
+            if code == 200:
+                self._send_json(200, body)
+            else:
+                self._send(code, str(body).encode(), "text/plain")
+        elif path.startswith("/.jobs/") and path.endswith("/metrics.json"):
+            job_id = path[len("/.jobs/"):-len("/metrics.json")]
+            try:
+                window = int(parse_qs(query).get("n", [0])[0]) or None
+            except ValueError:
+                window = None
+            code, body = self.explorer_app.job_metrics(job_id, window)
             if code == 200:
                 self._send_json(200, body)
             else:

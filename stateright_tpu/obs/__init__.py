@@ -22,6 +22,17 @@ and *liveness*:
   phase ``"idle"`` with ``seq`` incremented after it returns. Watchdogs
   (bench.py, tools/tpu_watch.sh) read staleness + phase to distinguish a
   wedged tunnel from a long XLA compile in-band.
+- :class:`~stateright_tpu.obs.timeseries.MetricsRecorder` — the snapshot
+  layer over time (``STPU_METRICS_TO=path`` / ``spawn_xla(metrics_to=...)``):
+  append-only rotating ``metrics.jsonl`` of ``checker.metrics()`` rows
+  sampled at quiescent superstep boundaries on a level/wall-clock
+  cadence; :func:`~stateright_tpu.obs.timeseries.read_series` reassembles
+  the rotation chain.
+- :mod:`~stateright_tpu.obs.promexport` — OpenMetrics rendering of any
+  snapshot or series tail (``stpu_*`` counter/gauge families with
+  ``job``/``engine``/``dedup`` labels), served by the Explorer as
+  ``GET /.metrics``; ships the validating parser the tests and smoke
+  stage scrape with.
 
 Everything here is OFF by default and adds **no device syncs** when on:
 spans only wrap host boundaries and reuse scalars the host already fetches.
@@ -39,16 +50,20 @@ from typing import Optional, Union
 
 from .heartbeat import Heartbeat
 from .metrics import Counters
+from .timeseries import MetricsRecorder, read_series
 from .trace import NULL_TRACER, Span, Tracer, export_chrome
 
 __all__ = [
     "Counters",
     "Heartbeat",
+    "MetricsRecorder",
     "NULL_TRACER",
     "Span",
     "Tracer",
     "export_chrome",
+    "read_series",
     "resolve_heartbeat",
+    "resolve_recorder",
     "resolve_tracer",
 ]
 
@@ -94,3 +109,13 @@ def resolve_heartbeat(heartbeat: Union[None, str, Heartbeat] = None) -> Optional
     if heartbeat is None:
         return None
     return Heartbeat(heartbeat)
+
+
+def resolve_recorder(metrics_to=None, metrics_every=None, metrics_keep=None):
+    """The metrics recorder a checker should sample into, or None (the
+    default — same off-by-default pin discipline as the tracer). Accepts
+    a live :class:`MetricsRecorder` (shared-series embedders), a path, or
+    the ``STPU_METRICS_{TO,EVERY,KEEP}`` env knobs."""
+    if isinstance(metrics_to, MetricsRecorder):
+        return metrics_to
+    return MetricsRecorder.resolve(metrics_to, metrics_every, metrics_keep)

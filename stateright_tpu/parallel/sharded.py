@@ -83,6 +83,9 @@ class ShardedXlaChecker(Checker):
         host_verified_cap: int = 128,
         trace=None,
         heartbeat=None,
+        metrics_to=None,
+        metrics_every=None,
+        metrics_keep: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -205,6 +208,16 @@ class ShardedXlaChecker(Checker):
         # engine adds a route-buffer growth counter to the shared seed.
         self._tracer = obs.resolve_tracer(trace)
         self._heartbeat = obs.resolve_heartbeat(heartbeat)
+        # Recorder gated to process 0, like save_checkpoint: under
+        # jax.distributed every rank reaches the same quiescent point
+        # with the same gauges, so rank 0's rows ARE the series — and
+        # concurrent appenders on one base path would double-count rows
+        # and double-shift the rotation chain out from under each other.
+        self._recorder = (
+            obs.resolve_recorder(metrics_to, metrics_every, metrics_keep)
+            if jax.process_index() == 0
+            else None
+        )
         self._counters = obs.Counters(ENGINE_COUNTERS + ("route_grows",))
         self.dispatch_log = []
         # Recovery surface — same contract as the single-chip engine
@@ -223,6 +236,8 @@ class ShardedXlaChecker(Checker):
             self._restore(checkpoint)
             if self._autockpt is not None:
                 self._autockpt.arm(self._depth)
+            if self._recorder is not None:
+                self._recorder.arm(self._depth)
             return
 
         # --- initial device state ----------------------------------------
@@ -262,6 +277,8 @@ class ShardedXlaChecker(Checker):
         self._exhausted = n_init == 0
         if self._autockpt is not None:
             self._autockpt.arm(self._depth)
+        if self._recorder is not None:
+            self._recorder.arm(self._depth)
 
     # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
 
@@ -280,8 +297,12 @@ class ShardedXlaChecker(Checker):
         XlaChecker.save_checkpoint(self, path, keep)
 
     # The in-loop auto-checkpoint hook routes through save_checkpoint
-    # above, so the process-0 gate covers automatic writes too.
+    # above, so the process-0 gate covers automatic writes too. The
+    # metrics time-series hook samples at the same quiescent points
+    # (metrics() here is host-side cached reads — no device dispatch, so
+    # multi-process SPMD program order is safe).
     _maybe_checkpoint = XlaChecker._maybe_checkpoint
+    _maybe_record = XlaChecker._maybe_record
 
     def _restore(self, path: str) -> None:
         """Loads a checkpoint, re-routing frontier rows and table entries to
@@ -1515,6 +1536,7 @@ class ShardedXlaChecker(Checker):
             # Quiescent point: the committed prefix is fully reflected in
             # host-visible state.
             self._maybe_checkpoint()
+            self._maybe_record()
             if (
                 self._target_state_count is not None
                 and self._state_count >= self._target_state_count
@@ -1621,6 +1643,7 @@ class ShardedXlaChecker(Checker):
         if self._hv_idx:
             self._confirm_hv_candidates(hv_w, hv_f, hv_c)
         self._maybe_checkpoint()
+        self._maybe_record()
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count
@@ -1694,6 +1717,7 @@ class ShardedXlaChecker(Checker):
             "shrink_exit": False,
             "levels_per_dispatch": self._levels_per_dispatch,
             "checkpoint_to": self._autockpt.path if self._autockpt else None,
+            "metrics_to": self._recorder.path if self._recorder else None,
             # -- recovery gauges (docs/observability.md "Recovery") ----
             "resumed_from": self._resumed_from,
             "last_checkpoint_level": (

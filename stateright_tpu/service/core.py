@@ -252,6 +252,10 @@ class Job:
     def trace_path(self) -> str:
         return self._path("trace.jsonl")
 
+    @property
+    def metrics_path(self) -> str:
+        return self._path("metrics.jsonl")
+
     # -- surface -----------------------------------------------------------
 
     @property
@@ -288,6 +292,16 @@ class Job:
             "lint": self.lint,
             "error": self.error,
             "recovered": self.recovered,
+            # Liveness/recovery ages, host-side from file mtimes (the
+            # dashboard's per-job staleness + checkpoint-age readouts;
+            # docs/observability.md "Dashboard"): None when the artifact
+            # does not exist (host-engine jobs, swept dirs, heartbeat off).
+            "heartbeat_age_s": (
+                _mtime_age(self._path("hb.json")) if self.dir else None
+            ),
+            "checkpoint_age_s": (
+                _mtime_age(self.checkpoint_path) if self.dir else None
+            ),
         }
         if self.result is not None:
             out["result"] = {
@@ -342,6 +356,14 @@ class Job:
             return self.checker.metrics()
         if self.result is not None:
             return self.result.get("metrics")
+        return None
+
+
+def _mtime_age(path: str) -> Optional[float]:
+    """Seconds since ``path`` was last written, or None when absent."""
+    try:
+        return round(max(0.0, time.time() - os.stat(path).st_mtime), 3)
+    except OSError:
         return None
 
 
@@ -1295,7 +1317,8 @@ class CheckerService:
         for key in (
             "STPU_TRACE", "STPU_TRACE_CHROME", "STPU_HEARTBEAT",
             "STPU_CHECKPOINT_TO", "STPU_CHECKPOINT_EVERY",
-            "STPU_CHECKPOINT_KEEP",
+            "STPU_CHECKPOINT_KEEP", "STPU_METRICS_TO",
+            "STPU_METRICS_EVERY", "STPU_METRICS_KEEP",
         ):
             env.pop(key, None)
         if device:
@@ -1364,6 +1387,7 @@ class CheckerService:
                 "--checkpoint", job.checkpoint_path,
                 "--every", str(cfg.checkpoint_every),
                 "--keep", str(cfg.checkpoint_keep),
+                "--metrics", job.metrics_path,
             ]
             if resume:
                 argv += ["--resume", resume]
@@ -1693,6 +1717,23 @@ class CheckerService:
                 jid: self._jobs[jid].snapshot() for jid in self._order
             }
         return out
+
+    def job_metrics_series(
+        self, job_id: str, window: Optional[int] = None
+    ) -> Optional[List[Dict[str, Any]]]:
+        """A batch job's recorded metrics time-series (the per-job
+        ``metrics.jsonl`` the worker samples at quiescent superstep
+        boundaries; docs/observability.md "Time series"), newest-``window``
+        rows, oldest first. None when the job never produced a series
+        (host-engine jobs, swept artifacts) or is interactive (live
+        checkers are polled, not recorded — the Explorer samples those
+        itself). Raises ``KeyError`` on an unknown job id."""
+        from ..obs import read_series
+
+        job = self._jobs[job_id]
+        if job.dir is None or not os.path.exists(job.metrics_path):
+            return None
+        return read_series(job.metrics_path, window=window)
 
     def job_trace_chrome(self, job_id: str,
                          out_path: Optional[str] = None) -> Optional[str]:

@@ -11,10 +11,12 @@ service invokes the latter so the child needs no import-path inheritance).
 Engines:
 
 - ``--engine xla`` (default): the single-chip device engine with per-job
-  in-loop auto-checkpointing (``--checkpoint``/``--every``/``--keep``) and
-  resume (``--resume``). The heartbeat rides in via ``STPU_HEARTBEAT``
-  (injected by ``run_worker``), the span trace via ``STPU_TRACE`` — both
-  per-job files under the service's run dir.
+  in-loop auto-checkpointing (``--checkpoint``/``--every``/``--keep``),
+  resume (``--resume``), and a per-job metrics time-series
+  (``--metrics`` → quiescent-boundary samples plus a forced final row;
+  docs/observability.md "Time series"). The heartbeat rides in via
+  ``STPU_HEARTBEAT`` (injected by ``run_worker``), the span trace via
+  ``STPU_TRACE`` — all per-job files under the service's run dir.
 - ``--engine host``: the host on-demand engine
   (``stateright_tpu/checker/on_demand.py``) unblocked and driven in
   ``--block-size`` blocks — the breaker's graceful-degradation target. No
@@ -78,6 +80,7 @@ def main() -> int:
     p.add_argument("--platform", default="default")  # "default" | "cpu"
     p.add_argument("--out", required=True)
     p.add_argument("--checkpoint", default=None)  # auto-checkpoint base
+    p.add_argument("--metrics", default=None)  # metrics time-series base
     p.add_argument("--resume", default=None)
     p.add_argument("--every", default="1")
     p.add_argument("--keep", type=int, default=3)
@@ -145,6 +148,11 @@ def main() -> int:
                 checkpoint_every=args.every,
                 checkpoint_keep=args.keep,
             )
+        if args.metrics:
+            # Per-job metrics time-series (docs/observability.md "Time
+            # series"): sampled at quiescent boundaries into the job dir;
+            # a requeued attempt appends to the same rotating series.
+            kw["metrics_to"] = args.metrics
         if args.resume:
             kw["checkpoint"] = args.resume
         checker = builder.spawn_xla(**kw)
@@ -178,6 +186,12 @@ def main() -> int:
             return 3  # soft budget exit at a quiescent point
 
     metrics = checker.metrics()
+    recorder = getattr(checker, "_recorder", None)
+    if recorder is not None:
+        # Final forced row: the series ends with the completed run's
+        # exact totals regardless of cadence (dashboards and the
+        # OpenMetrics tail read the last row as "current").
+        recorder.sample(metrics, kind="engine")
     result = {
         "spec": args.spec,
         "engine": args.engine,

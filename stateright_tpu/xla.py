@@ -290,6 +290,9 @@ class XlaChecker(Checker):
         cand_ladder: Any = "auto",
         trace: Any = None,
         heartbeat: Any = None,
+        metrics_to: Any = None,
+        metrics_every: Any = None,
+        metrics_keep: Optional[int] = None,
     ):
         import jax
 
@@ -299,10 +302,15 @@ class XlaChecker(Checker):
         self._jax = jax
         # Observability (stateright_tpu/obs, docs/observability.md): a
         # span tracer (NULL_TRACER when off — no clocks, no I/O), a
-        # heartbeat writer (None when off), and the event-counter half of
-        # metrics(). All host-side; never a device sync.
+        # heartbeat writer (None when off), a metrics time-series
+        # recorder (None when off — sampled only at quiescent superstep
+        # boundaries), and the event-counter half of metrics(). All
+        # host-side; never a device sync.
         self._tracer = obs.resolve_tracer(trace)
         self._heartbeat = obs.resolve_heartbeat(heartbeat)
+        self._recorder = obs.resolve_recorder(
+            metrics_to, metrics_every, metrics_keep
+        )
         self._counters = obs.Counters(ENGINE_COUNTERS)
         # Recovery surface (stateright_tpu/checkpoint.py): in-loop
         # auto-checkpointing at superstep boundaries (the quiescent
@@ -673,6 +681,8 @@ class XlaChecker(Checker):
             self._restore(checkpoint)
             if self._autockpt is not None:
                 self._autockpt.arm(self._depth)
+            if self._recorder is not None:
+                self._recorder.arm(self._depth)
             return
 
         init_packed = np.asarray(model.packed_init(), dtype=np.uint32)
@@ -714,6 +724,8 @@ class XlaChecker(Checker):
         self._exhausted = n_init == 0
         if self._autockpt is not None:
             self._autockpt.arm(self._depth)
+        if self._recorder is not None:
+            self._recorder.arm(self._depth)
 
     # --- checkpoint/resume (stateright_tpu/checkpoint.py) ------------------
 
@@ -745,6 +757,15 @@ class XlaChecker(Checker):
         ``STPU_CHECKPOINT_TO`` armed a cadence."""
         if self._autockpt is not None:
             self._autockpt.maybe(self)
+
+    def _maybe_record(self) -> None:
+        """Metrics time-series hook, called at the same quiescent points
+        as :meth:`_maybe_checkpoint` — ``metrics()`` is pure host-side
+        reads there, so a sample never adds a device sync. No-op unless
+        ``spawn_xla(metrics_to=...)`` / ``STPU_METRICS_TO`` armed a
+        recorder (docs/observability.md "Time series")."""
+        if self._recorder is not None:
+            self._recorder.maybe(self)
 
     def _restore(self, path: str) -> None:
         """Replaces the freshly-initialized search state with a checkpoint's
@@ -2354,6 +2375,7 @@ class XlaChecker(Checker):
             # host-visible state (even when this iteration ended on an
             # overflow — the overflowing level was not committed).
             self._maybe_checkpoint()
+            self._maybe_record()
             if (
                 self._target_state_count is not None
                 and self._state_count >= self._target_state_count
@@ -2516,6 +2538,7 @@ class XlaChecker(Checker):
             self._confirm_hv_candidates(hv_words, hv_fps, hv_counts)
         self._pin_found_names()
         self._maybe_checkpoint()
+        self._maybe_record()
         if (
             self._target_state_count is not None
             and self._state_count >= self._target_state_count
@@ -2646,6 +2669,7 @@ class XlaChecker(Checker):
             "shrink_exit": self._shrink_exit,
             "levels_per_dispatch": self._levels_per_dispatch,
             "checkpoint_to": self._autockpt.path if self._autockpt else None,
+            "metrics_to": self._recorder.path if self._recorder else None,
             # -- recovery gauges (docs/observability.md "Recovery") ----
             "resumed_from": self._resumed_from,
             "last_checkpoint_level": (

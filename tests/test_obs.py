@@ -1,11 +1,14 @@
 """The observability layer (stateright_tpu/obs; docs/observability.md):
 span JSONL schema, Chrome trace-event export validity, the heartbeat
 protocol, the unified ``checker.metrics()`` snapshot, the normalized
-``dispatch_log`` shape, and the zero-overhead guarantee with tracing off.
+``dispatch_log`` shape, the metrics time-series recorder (row schema,
+keep-K rotation, quiescent-boundary-only sampling), and the
+zero-overhead guarantee with tracing/recording off.
 
 These are SCHEMA pins: consumers (tools/roofline.py --measured, the
-bench watchdog, tools/tpu_watch.sh, Perfetto) parse these artifacts, so
-a key rename here is a breaking change, not a refactor.
+bench watchdog, tools/tpu_watch.sh, Perfetto, obs/promexport.py, the
+``/.dash`` dashboard) parse these artifacts, so a key rename here is a
+breaking change, not a refactor.
 """
 
 import json
@@ -54,7 +57,14 @@ METRIC_KEYS = {
     # level, and the write counter.
     "checkpoint_to", "resumed_from", "last_checkpoint_level",
     "checkpoints_written",
+    # time-series config gauge (docs/observability.md "Time series").
+    "metrics_to",
 }
+
+#: The metrics time-series row schema (exactly these keys;
+#: docs/observability.md "Time series" — promexport, the dashboard, and
+#: roofline's series mode parse these).
+RECORDER_ROW_KEYS = {"v", "unix_ts", "t", "seq", "kind", "metrics"}
 
 
 def _spans(path):
@@ -234,6 +244,9 @@ def test_explorer_status_carries_metrics():
     # Recovery state is part of the status surface: a wedged interactive
     # session must be diagnosable (and resumable) from /.status alone.
     assert "last_checkpoint" in status
+    # Liveness too: heartbeat_age_s rides next to last_checkpoint — None
+    # here (no heartbeat configured), a float age when the protocol is on.
+    assert status["heartbeat_age_s"] is None
 
 
 def test_checkpoint_span_per_write(tmp_path):
@@ -254,6 +267,98 @@ def test_checkpoint_span_per_write(tmp_path):
     assert len(spans) == m["checkpoints_written"]
     for rec in spans:
         assert {"path", "depth", "keep"} <= set(rec["attrs"])
+
+
+# --- metrics time-series recorder ----------------------------------------
+
+
+def test_recorder_rows_schema_and_quiescent_cadence(tmp_path):
+    from stateright_tpu.obs import read_series
+
+    series = str(tmp_path / "metrics.jsonl")
+    # Level cadence 1 + one level per dispatch: a sample opportunity at
+    # every quiescent boundary, so the series traces the whole run.
+    c = _spawn(metrics_to=series, metrics_every=1, levels_per_dispatch=1).join()
+    assert c.unique_state_count() == 288
+    assert c.metrics()["metrics_to"] == series
+    rows = read_series(series)
+    assert rows, "series is empty"
+    for rec in rows:
+        assert set(rec) == RECORDER_ROW_KEYS, rec
+        assert rec["v"] == 1
+        assert rec["kind"] == "engine"
+        assert isinstance(rec["t"], (int, float)) and rec["t"] >= 0
+        # Each row embeds a full metrics() snapshot (stable key set).
+        assert METRIC_KEYS <= set(rec["metrics"]), rec["metrics"]
+    assert [r["seq"] for r in rows] == list(range(len(rows)))
+    # Quiescent-boundary-only sampling: never more samples than device
+    # dispatches (each dispatch ends in at most one quiescent point) and
+    # the embedded progress gauges advance monotonically.
+    assert len(rows) <= len(c.dispatch_log)
+    depths = [r["metrics"]["depth"] for r in rows]
+    states = [r["metrics"]["state_count"] for r in rows]
+    assert depths == sorted(depths)
+    assert states == sorted(states)
+    # The wall-clock cadence spec parses too (no run needed to pin the
+    # grammar — it is the checkpoint module's).
+    from stateright_tpu.obs import MetricsRecorder
+
+    r = MetricsRecorder(str(tmp_path / "w.jsonl"), every="2.5s")
+    assert r.every_seconds == 2.5 and r.every_levels is None
+    with pytest.raises(ValueError):
+        MetricsRecorder(str(tmp_path / "bad.jsonl"), every="nope")
+
+
+def test_recorder_rotation_and_torn_tail(tmp_path):
+    from stateright_tpu.obs import MetricsRecorder, read_series
+    from stateright_tpu.obs.timeseries import series_files
+
+    base = str(tmp_path / "metrics.jsonl")
+    rec = MetricsRecorder(base, every=1, keep=3, rotate_rows=4)
+    for i in range(10):
+        rec.sample({"state_count": i})
+    # 10 rows at 4/file: two full rotations + 2 live rows, keep=3 retains
+    # all of them; the chain reads back oldest-first and in order.
+    assert series_files(base) == [f"{base}.2", f"{base}.1", base]
+    rows = read_series(base)
+    assert [r["metrics"]["state_count"] for r in rows] == list(range(10))
+    assert [r["seq"] for r in rows] == list(range(10))
+    # keep bounds the chain: 8 more rows shift two more rotations and the
+    # oldest files fall off the end.
+    for i in range(10, 18):
+        rec.sample({"state_count": i})
+    assert series_files(base) == [f"{base}.2", f"{base}.1", base]
+    rows = read_series(base)
+    # rows 0..7 fell off the end of the keep=3 chain; 8..17 survive.
+    assert [r["metrics"]["state_count"] for r in rows] == list(range(8, 18))
+    # A torn tail (kill mid-append) is skipped, not fatal; the window
+    # argument trims to the newest N.
+    rec.sample({"state_count": 99})
+    rec.close()
+    with open(base, "a") as fh:
+        fh.write('{"v": 1, "metrics": {"state_coun')
+    rows = read_series(base)
+    assert rows[-1]["metrics"]["state_count"] == 99
+    assert [r["metrics"]["state_count"] for r in read_series(base, window=2)] == [17, 99]
+    # A recorder RE-OPENED over the torn file (the requeued worker's
+    # resume path) repairs the tail first: its next row lands on its own
+    # line instead of concatenating onto the fragment and vanishing.
+    rec2 = MetricsRecorder(base, every=1, keep=3, rotate_rows=100)
+    rec2.sample({"state_count": 100})
+    rows = read_series(base)
+    assert [r["metrics"]["state_count"] for r in rows[-2:]] == [99, 100]
+    rec2.close()
+
+
+def test_recorder_env_knob(tmp_path, monkeypatch):
+    from stateright_tpu.obs import read_series
+
+    series = str(tmp_path / "env_metrics.jsonl")
+    monkeypatch.setenv("STPU_METRICS_TO", series)
+    monkeypatch.setenv("STPU_METRICS_EVERY", "1")
+    c = _spawn().join()
+    assert c._recorder is not None and c._recorder.path == series
+    assert read_series(series)
 
 
 # --- dispatch_log contract ------------------------------------------------
@@ -310,9 +415,15 @@ def test_dispatch_log_records_uncommitted_dispatches():
 
 
 def test_sharded_dispatch_log_metrics_and_heartbeat(tmp_path):
+    from stateright_tpu.obs import read_series
+
     trace = str(tmp_path / "mesh.jsonl")
     hb = str(tmp_path / "mesh_hb.json")
-    c = _spawn(mesh=default_mesh(), trace=trace, heartbeat=hb).join()
+    series = str(tmp_path / "mesh_metrics.jsonl")
+    c = _spawn(
+        mesh=default_mesh(), trace=trace, heartbeat=hb,
+        metrics_to=series, metrics_every=1,
+    ).join()
     assert c.unique_state_count() == 288
     _check_dispatch_log_shape(c.dispatch_log)
     m = c.metrics()
@@ -323,6 +434,12 @@ def test_sharded_dispatch_log_metrics_and_heartbeat(tmp_path):
     disp = [r for r in _spans(trace) if r["name"] == "dispatch"]
     assert len(disp) == len(c.dispatch_log)
     assert hb_mod.read(hb)["seq"] == len(c.dispatch_log)
+    # The mesh engine records the same time-series contract: full
+    # snapshots at quiescent boundaries only.
+    rows = read_series(series)
+    assert rows and all(set(r) == RECORDER_ROW_KEYS for r in rows)
+    assert len(rows) <= len(c.dispatch_log)
+    assert rows[-1]["metrics"]["engine"] == "xla-sharded"
 
 
 # --- zero overhead when off ----------------------------------------------
@@ -333,13 +450,18 @@ def test_tracing_off_is_nulled_and_bit_identical(tmp_path):
 
     off = _spawn().join()
     # No obs machinery on the hot path: the shared no-op tracer (no
-    # clocks, no file), no heartbeat file at all.
+    # clocks, no file), no heartbeat file, no metrics recorder — the
+    # recorder shares the tracer's off-by-default pin discipline.
     assert off._tracer is NULL_TRACER
     assert off._heartbeat is None
+    assert off._recorder is None
 
     trace = str(tmp_path / "trace.jsonl")
     hb = str(tmp_path / "hb.json")
-    on = _spawn(trace=trace, heartbeat=hb).join()
+    on = _spawn(
+        trace=trace, heartbeat=hb,
+        metrics_to=str(tmp_path / "metrics.jsonl"), metrics_every=1,
+    ).join()
     # Engine results are bit-identical with tracing on: same counts, same
     # schedule, same per-level telemetry (spans only *observe* host
     # boundaries; they never change what runs on the device).

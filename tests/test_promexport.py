@@ -1,0 +1,313 @@
+"""OpenMetrics export + the Explorer telemetry endpoints (ISSUE 13):
+
+- the renderer/parser round-trip (``stateright_tpu/obs/promexport.py``):
+  ``# TYPE`` discipline, counter ``_total`` suffixes, label escaping, the
+  ``# EOF`` terminator — and the parser REJECTS malformed expositions, so
+  the smoke stage's scrape is a real validation, not a string match;
+- label sets stable across the three dedup structures (a Prometheus
+  scraper must see one schema whether a job ran hash/sorted/delta);
+- ``GET /.metrics`` served end-to-end against a live service-backed
+  Explorer with every counter cross-checked against ``checker.metrics()``
+  EXACTLY, plus the windowed ``GET /.jobs/{id}/metrics.json`` series and
+  the ``/.dash`` dashboard assets — through a real HTTP socket;
+- a batch job's recorded per-job series (``service/worker.py`` sampling
+  at quiescent boundaries into the job dir) served back through the pool.
+
+``test_smoke_metrics_endpoint`` (<30 s) rides in tools/smoke.sh.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from stateright_tpu.checker.explorer import _ExplorerHandler, make_app
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+from stateright_tpu.obs import promexport as pe
+from stateright_tpu.service import CheckerService, ServiceConfig
+
+KW = dict(frontier_capacity=1 << 8, table_capacity=1 << 10)
+
+#: ONE shared model instance (the test_obs.py pattern): compiled
+#: supersteps cache on the model, so every spawn after the first reuses
+#: the XLA programs instead of paying a fresh compile.
+MODEL = PackedTwoPhaseSys(2)
+#: 2pc rm=2 full-coverage counts (host oracle; bench.py pins rm>=3).
+EXPECTED = (154, 56)
+
+
+def _service(tmp_path, **kw):
+    base = dict(
+        run_dir=str(tmp_path / "svc"),
+        platform="cpu",
+        stall_s=8.0,
+        startup_grace_s=240.0,
+        poll_s=0.2,
+        probe_auto=False,
+        admission_lint=False,
+    )
+    base.update(kw)
+    return CheckerService(ServiceConfig(**base))
+
+
+# --- renderer / parser ----------------------------------------------------
+
+
+def test_render_parse_round_trip():
+    samples = [
+        ("stpu_state_count_total", {"engine": "xla", "dedup": "sorted"}, 154.0),
+        ("stpu_table_occupancy", {"engine": "xla", "dedup": "sorted"}, 0.0546875),
+        ("stpu_pool_queued", {}, 3.0),
+        # Label values needing escapes survive the round trip.
+        ("stpu_frontier_count", {"job": 'we"ird\nname\\x'}, 7.0),
+    ]
+    text = pe.render_openmetrics(samples)
+    assert text.endswith("# EOF\n")
+    lines = text.splitlines()
+    assert "# TYPE stpu_state_count counter" in lines
+    assert "# TYPE stpu_table_occupancy gauge" in lines
+    parsed = pe.parse_openmetrics(text)
+    assert len(parsed) == len(samples)
+    for name, labels, value in samples:
+        assert parsed[(name, frozenset(labels.items()))] == pytest.approx(value)
+
+
+def test_parser_rejects_malformed():
+    ok = pe.render_openmetrics([("stpu_depth", {"engine": "xla"}, 4.0)])
+    # Missing terminator.
+    with pytest.raises(ValueError, match="EOF"):
+        pe.parse_openmetrics(ok.replace("# EOF\n", ""))
+    # A sample with no preceding # TYPE.
+    with pytest.raises(ValueError, match="TYPE"):
+        pe.parse_openmetrics("stpu_x 1\n# EOF")
+    # Counter family sample without the _total suffix.
+    with pytest.raises(ValueError, match="_total"):
+        pe.parse_openmetrics(
+            "# TYPE stpu_x counter\nstpu_x 1\n# EOF"
+        )
+    # Unparseable value.
+    with pytest.raises(ValueError, match="value"):
+        pe.parse_openmetrics(
+            "# TYPE stpu_x gauge\nstpu_x banana\n# EOF"
+        )
+    # Duplicate sample (same name + label set).
+    with pytest.raises(ValueError, match="duplicate"):
+        pe.parse_openmetrics(
+            "# TYPE stpu_x gauge\nstpu_x 1\nstpu_x 2\n# EOF"
+        )
+
+
+def _counter_names(parsed):
+    return {name for name, _ in parsed if name.endswith("_total")}
+
+
+@pytest.mark.parametrize("dedup", ["hash", "sorted", "delta"])
+def test_label_and_family_sets_stable_across_dedups(dedup):
+    c = MODEL.checker().spawn_xla(dedup=dedup, **KW).join()
+    assert (c.state_count(), c.unique_state_count()) == EXPECTED
+    m = c.metrics()
+    parsed = pe.parse_openmetrics(
+        pe.render_openmetrics(pe.engine_samples(m, {"job": "j1"}))
+    )
+    # Every sample carries exactly the identity triple, with the dedup
+    # label tracking the structure.
+    for (_name, labels) in parsed:
+        assert dict(labels) == {"job": "j1", "engine": "xla", "dedup": dedup}
+    # The family set is dedup-independent (one scraper schema): pin the
+    # core families every structure must expose.
+    names = {name for name, _ in parsed}
+    assert {
+        "stpu_state_count_total", "stpu_unique_state_count_total",
+        "stpu_dispatches_total", "stpu_levels_committed_total",
+        "stpu_table_grows_total", "stpu_delta_flushes_total",
+        "stpu_checkpoints_written_total", "stpu_frontier_count",
+        "stpu_table_capacity", "stpu_table_occupancy", "stpu_depth",
+        "stpu_hv_flagged",
+    } <= names, names
+    if not hasattr(test_label_and_family_sets_stable_across_dedups, "_names"):
+        test_label_and_family_sets_stable_across_dedups._names = names
+    assert names == test_label_and_family_sets_stable_across_dedups._names
+
+
+# --- Explorer endpoints ---------------------------------------------------
+
+
+def _exact_cross_check(parsed, m, job_label):
+    """Every counter the exposition claims for this job matches
+    checker.metrics() EXACTLY (the acceptance criterion)."""
+    labels = frozenset(
+        {("job", job_label), ("engine", m["engine"]), ("dedup", m["dedup"])}
+    )
+    checked = 0
+    for key in pe.COUNTER_KEYS:
+        if key not in m:
+            continue
+        assert parsed[(f"stpu_{key}_total", labels)] == m[key], key
+        checked += 1
+    assert checked >= 10
+    assert parsed[("stpu_table_occupancy", labels)] == pytest.approx(
+        m["table_occupancy"]
+    )
+    return checked
+
+
+def test_smoke_metrics_endpoint(tmp_path):
+    """The smoke-stage drill (tools/smoke.sh): one packed model run with
+    the recorder on, ``/.metrics`` scraped from a make_app instance,
+    validated with the parser, counters cross-checked exactly."""
+    from stateright_tpu.obs import read_series
+
+    svc = _service(tmp_path)
+    try:
+        app, checker = make_app(
+            MODEL.checker(), service=svc,
+            metrics_to=str(tmp_path / "metrics.jsonl"), metrics_every=1,
+            **KW,
+        )
+        try:
+            checker.run_to_completion()
+            for _ in range(64):
+                if checker.is_done():
+                    break
+                app.drive(10_000)
+            assert checker.is_done()
+            assert (checker.state_count(), checker.unique_state_count()) == EXPECTED
+            # The recorder sampled the interactive run at quiescent
+            # boundaries.
+            rows = read_series(str(tmp_path / "metrics.jsonl"))
+            assert rows and rows[-1]["metrics"]["state_count"] == EXPECTED[0]
+            # Scrape + validate + exact cross-check.
+            m = checker.metrics()
+            parsed = pe.parse_openmetrics(app.metrics_text())
+            job_id = app.status()["job"]
+            _exact_cross_check(parsed, m, job_id)
+            # Pool families ride alongside (this session occupies one
+            # interactive slot).
+            assert parsed[("stpu_pool_interactive", frozenset())] == 1
+            assert parsed[("stpu_pool_breaker_open", frozenset())] == 0
+            # The windowed per-job series endpoint serves the live ring.
+            code, body = app.job_metrics(job_id, window=16)
+            assert code == 200
+            assert body["rows"][-1]["metrics"]["state_count"] == EXPECTED[0]
+        finally:
+            app.close()
+    finally:
+        svc.close()
+
+
+def test_metrics_endpoint_without_service():
+    # make_app always builds a default pool; ExplorerApp without one is
+    # the embedder path — construct it directly.
+    from stateright_tpu.checker.explorer import ExplorerApp
+
+    checker = MODEL.checker().spawn_xla(**KW)
+    bare = ExplorerApp(checker)
+    parsed = pe.parse_openmetrics(bare.metrics_text())
+    labels = frozenset(
+        {("job", "interactive"), ("engine", "xla"),
+         ("dedup", checker.metrics()["dedup"])}
+    )
+    assert ("stpu_state_count_total", labels) in parsed
+    # No pool families without a service; the live ring serves under the
+    # "interactive" id and 404s anything else.
+    assert not any(n.startswith("stpu_pool_") for n, _ in parsed)
+    assert bare.job_metrics("interactive")[0] == 200
+    assert bare.job_metrics("nope")[0] == 404
+    # A zero/negative window clamps to 1 — it must not bypass the cap
+    # and stream the whole series in one poll.
+    code, body = bare.job_metrics("interactive", window=-5)
+    assert code == 200 and body["window"] == 1 and len(body["rows"]) == 1
+    # The live ring's row seq is strictly monotonic across polls (the
+    # recorder row contract), not the ring length.
+    seqs = [
+        bare.job_metrics("interactive")[1]["rows"][-1]["seq"]
+        for _ in range(3)
+    ]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 3
+
+
+def test_http_end_to_end(tmp_path):
+    """The real socket path: /.metrics content type + parse, the
+    dashboard assets, and the windowed series endpoint with ?n=."""
+    from http.server import ThreadingHTTPServer
+
+    svc = _service(tmp_path)
+    app, checker = make_app(MODEL.checker(), service=svc, **KW)
+
+    class Handler(_ExplorerHandler):
+        explorer_app = app
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as res:
+                return res.status, res.headers.get("Content-Type"), res.read()
+
+        status, ctype, body = get("/.metrics")
+        assert status == 200
+        assert ctype == pe.CONTENT_TYPE
+        parsed = pe.parse_openmetrics(body.decode())
+        job_id = app.status()["job"]
+        _exact_cross_check(parsed, checker.metrics(), job_id)
+
+        status, ctype, body = get(f"/.jobs/{job_id}/metrics.json?n=2")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["job"] == job_id and doc["window"] == 2
+        assert len(doc["rows"]) >= 1
+        assert {"v", "unix_ts", "t", "seq", "kind", "metrics"} == set(doc["rows"][-1])
+
+        status, ctype, body = get("/.dash")
+        assert status == 200 and ctype == "text/html"
+        assert b"Pool dashboard" in body
+        status, ctype, body = get("/dash.js")
+        assert status == 200 and ctype == "text/javascript"
+        assert b"/.jobs/" in body and b"/.pool" in body
+    finally:
+        server.shutdown()
+        app.close()
+        svc.close()
+
+
+def test_batch_job_series_served_through_pool(tmp_path):
+    """A real batch job records a per-job metrics.jsonl under its job dir
+    (worker.py quiescent sampling + forced final row) and the pool serves
+    it back windowed; /.metrics labels the finished job's recorded
+    snapshot with its job id."""
+    svc = _service(tmp_path)
+    try:
+        job = svc.submit("2pc:3")
+        assert job.wait(timeout=300), "job did not finish"
+        assert job.status == "done"
+        assert (job.result["generated"], job.result["unique"]) == (1146, 288)
+        rows = svc.job_metrics_series(job.id)
+        assert rows, "no per-job series recorded"
+        assert rows[-1]["metrics"]["state_count"] == 1146
+        windowed = svc.job_metrics_series(job.id, window=1)
+        assert len(windowed) == 1 and windowed[0] == rows[-1]
+        with pytest.raises(KeyError):
+            svc.job_metrics_series("nope")
+
+        # The finished job's snapshot renders into /.metrics under its id.
+        app, checker = make_app(MODEL.checker(), service=svc, **KW)
+        try:
+            parsed = pe.parse_openmetrics(app.metrics_text())
+            m = job.metrics()
+            labels = frozenset(
+                {("job", job.id), ("engine", m["engine"]),
+                 ("dedup", m["dedup"])}
+            )
+            assert parsed[("stpu_state_count_total", labels)] == 1146
+            # And the HTTP-facing series handler finds it too.
+            code, body = app.job_metrics(job.id, window=8)
+            assert code == 200 and body["rows"][-1] == rows[-1]
+        finally:
+            app.close()
+    finally:
+        svc.close()

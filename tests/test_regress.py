@@ -1,0 +1,208 @@
+"""tools/bench_regress.py — the perf-regression gate (ISSUE 13): typed
+verdicts against the archived BENCH_r*.json trajectory. No jax, no
+device — pure JSON in, one verdict line out. Pins the acceptance
+criterion's three behaviors (pass on real lines, fail on a synthetically
+degraded line, "no baseline" as a typed non-failure on an empty dir)
+plus the honest skips (resumed lines, missing platforms, missing chaos
+artifact) and the exit-code contract.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "bench_regress.py")
+
+_spec = importlib.util.spec_from_file_location("bench_regress", TOOL)
+br = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(br)
+
+
+def _archive(tmp_path, lines):
+    d = tmp_path / "archive"
+    d.mkdir(exist_ok=True)
+    for i, line in enumerate(lines, 1):
+        # The driver's wrapper shape ({"n", "cmd", "parsed": line}).
+        (d / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps({"n": i, "parsed": line})
+        )
+    return str(d)
+
+
+CPU_LINES = [
+    {"metric": "2pc(rm=6) generated states/sec, spawn_xla, cpu",
+     "value": 129014.5, "unit": "states/sec"},
+    {"metric": "2pc(rm=7) generated states/sec, spawn_xla, cpu",
+     "value": 600075.9, "unit": "states/sec"},
+    {"metric": "2pc(rm=7) generated states/sec, spawn_xla, cpu",
+     "value": 771620.7, "unit": "states/sec", "count_ok": True},
+]
+
+
+def _fresh(value, **kw):
+    line = {"metric": "2pc(rm=7) generated states/sec, spawn_xla, cpu",
+            "value": value, "count_ok": True}
+    line.update(kw)
+    return br.normalize_fresh(line)
+
+
+def test_trajectory_loading(tmp_path):
+    arch = _archive(tmp_path, CPU_LINES)
+    traj = br.load_trajectory(arch)
+    assert set(traj) == {"cpu"}
+    assert traj["cpu"]["best"] == 771620.7
+    assert traj["cpu"]["lines"] == 3
+    # Garbage files are skipped, not fatal.
+    (tmp_path / "archive" / "BENCH_r99.json").write_text("{torn")
+    assert br.load_trajectory(arch)["cpu"]["lines"] == 3
+
+
+def test_pass_on_real_trajectory(tmp_path):
+    traj = br.load_trajectory(_archive(tmp_path, CPU_LINES))
+    line = br.judge(_fresh(760_000.0), traj, None)
+    assert line["verdict"] == "pass"
+    by_name = {c["name"]: c for c in line["checks"]}
+    assert by_name["throughput"]["verdict"] == "pass"
+    assert by_name["count_ok"]["verdict"] == "pass"
+    assert by_name["slo"]["verdict"] == "skip"  # no chaos artifact
+
+
+def test_fail_on_degraded_line(tmp_path):
+    traj = br.load_trajectory(_archive(tmp_path, CPU_LINES))
+    line = br.judge(_fresh(100_000.0), traj, None)
+    assert line["verdict"] == "fail"
+    tp = [c for c in line["checks"] if c["name"] == "throughput"][0]
+    assert tp["verdict"] == "fail"
+    assert tp["baseline"] == 771620.7
+    # count_ok / lint_ok are independent failure axes.
+    assert br.judge(_fresh(760_000.0, count_ok=False), traj, None)["verdict"] == "fail"
+    assert br.judge(_fresh(760_000.0, lint_ok=False), traj, None)["verdict"] == "fail"
+
+
+def test_no_baseline_is_typed_nonfailure(tmp_path):
+    empty = tmp_path / "empty_archive"
+    empty.mkdir()
+    line = br.judge(_fresh(1.0), br.load_trajectory(str(empty)), None)
+    assert line["verdict"] == "no_baseline"
+    # ... but a missing archive only excuses the throughput comparison:
+    # an exact-count or lint violation still FAILS the gate.
+    assert br.judge(
+        _fresh(1.0, count_ok=False), br.load_trajectory(str(empty)), None
+    )["verdict"] == "fail"
+    assert br.judge(
+        _fresh(1.0, lint_ok=False), br.load_trajectory(str(empty)), None
+    )["verdict"] == "fail"
+
+
+def test_honest_skips(tmp_path):
+    traj = br.load_trajectory(_archive(tmp_path, CPU_LINES))
+    # A resumed line measures a checkpoint tail, not a cold pass: the
+    # throughput check skips instead of judging it, and a slow resumed
+    # line therefore cannot fail the gate.
+    line = br.judge(_fresh(5_000.0, resumed="measured"), traj, None)
+    tp = [c for c in line["checks"] if c["name"] == "throughput"][0]
+    assert tp["verdict"] == "skip"
+    assert line["verdict"] == "pass"
+    # A platform with no archived line yet: skip, not fail (banking the
+    # first chip line STARTS that trajectory).
+    tpu = br.normalize_fresh(
+        {"metric": "2pc(rm=8) generated states/sec, spawn_xla, tpu",
+         "value": 2.0e6, "count_ok": True}
+    )
+    line = br.judge(tpu, traj, None)
+    assert line["verdict"] == "pass"
+    assert [c for c in line["checks"] if c["name"] == "throughput"][0][
+        "verdict"] == "skip"
+
+
+def test_chaos_slo_checks(tmp_path):
+    traj = br.load_trajectory(_archive(tmp_path, CPU_LINES))
+    good = {
+        "ok": True,
+        "scenarios": {"baseline": {
+            "admission_latency_ms": {"p50": 3.0, "p99": 40.0},
+            "turnaround_s": {"p50": 9.0, "p99": 30.0},
+        }},
+    }
+    line = br.judge(_fresh(760_000.0), traj, good)
+    assert line["verdict"] == "pass"
+    assert [c for c in line["checks"] if c["name"] == "slo"][0]["verdict"] == "pass"
+    # p99 above the limit fails; a failed sweep fails outright.
+    slow = {"ok": True, "scenarios": {"baseline": {
+        "admission_latency_ms": {"p99": 99_000.0},
+        "turnaround_s": {"p99": 10.0},
+    }}}
+    assert br.judge(_fresh(760_000.0), traj, slow)["verdict"] == "fail"
+    assert br.judge(
+        _fresh(760_000.0), traj, {"ok": False, "scenarios": {}}
+    )["verdict"] == "fail"
+
+
+def test_normalize_fresh_from_bench_detail():
+    fresh = br.normalize_fresh(
+        {"platform": "cpu", "rm": 7, "states_per_sec": 700_000.0,
+         "count_ok": True, "lint_ok": True, "full_coverage": True,
+         "resume": {"phase": None}}
+    )
+    assert fresh["platform"] == "cpu"
+    assert fresh["value"] == 700_000.0
+    assert fresh["resumed"] is None
+    assert br.normalize_fresh({"unrelated": 1}) is None
+
+
+def test_cli_exit_codes_and_artifact(tmp_path):
+    arch = _archive(tmp_path, CPU_LINES)
+    fresh = tmp_path / "line.json"
+    out = tmp_path / "regress.json"
+
+    def run(value, **kw):
+        doc = {"metric": "x, spawn_xla, cpu", "value": value, "count_ok": True}
+        doc.update(kw)
+        fresh.write_text(json.dumps(doc))
+        return subprocess.run(
+            [sys.executable, TOOL, "--archive", arch, "--fresh", str(fresh),
+             "--chaos", str(tmp_path / "absent.json"), "--out", str(out)],
+            capture_output=True, text=True,
+        )
+
+    proc = run(760_000.0)
+    assert proc.returncode == 0, proc.stderr
+    banked = json.loads(out.read_text())
+    assert banked["verdict"] == "pass"
+    assert json.loads(proc.stdout)["verdict"] == "pass"
+
+    assert run(1_000.0).returncode == 1
+    assert json.loads(out.read_text())["verdict"] == "fail"
+
+    # Unreadable fresh line: typed error, exit 2.
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--archive", arch,
+         "--fresh", str(tmp_path / "missing.json"), "--out", str(out)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["verdict"] == "error"
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REPO, "runs", "archive")),
+    reason="no committed archive in this tree",
+)
+def test_self_test_against_committed_archive():
+    """The smoke-stage form: the gate proves all three verdicts against
+    the REAL runs/archive trajectory."""
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--self-test"], capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = json.loads(proc.stdout)
+    assert line["ok"] is True
+    assert line["cases"] == {
+        "real_line": "pass", "degraded_line": "fail",
+        "empty_archive": "no_baseline",
+    }

@@ -11,7 +11,10 @@
 # multi-tenant pool's tier-0 proof), and the <30s SERVICE RESTART drill
 # (the service process itself dies right after journaling `started`; the
 # restart replays the job journal, kills the orphaned worker, requeues,
-# and converges to exact counts — the durability tier's tier-0 proof).
+# and converges to exact counts — the durability tier's tier-0 proof),
+# and the <30s TELEMETRY drill (one packed model with the metrics
+# recorder on, /.metrics scraped from a make_app instance and validated
+# with the OpenMetrics test parser, counters cross-checked exactly).
 # A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
@@ -25,8 +28,16 @@ cd "$(dirname "$0")/.."
 mkdir -p runs
 timeout -k 5 60 python tools/stpu_lint.py --json-out runs/lint.json
 
-exec timeout -k 10 380 python -m pytest \
+# Perf-regression gate self-test (tools/bench_regress.py, ISSUE 13): the
+# gate proves its three typed verdicts against the committed
+# runs/archive trajectory — pass on the real lines, fail on a
+# synthetically degraded one, "no_baseline" on an empty dir. Pure JSON,
+# no jax, <5 s.
+timeout -k 5 60 python tools/bench_regress.py --self-test
+
+exec timeout -k 10 420 python -m pytest \
   tests/test_obs.py \
+  tests/test_promexport.py::test_smoke_metrics_endpoint \
   tests/test_fused_dispatch.py::test_fused_matches_single_full_coverage \
   tests/test_packed_increment.py \
   tests/test_supervise.py::test_smoke_kill_resume \

@@ -77,11 +77,22 @@ fi
 # harness (tools/service_chaos.py: baseline + SIGKILL-restart + torn-
 # journal scenarios, exactly-once + bit-identical counts, SLO line to
 # runs/service_chaos.json — bench_detail's "journal" provenance).
+# A bare "bench_regress" expands to the perf-regression gate
+# (tools/bench_regress.py): the freshest runs/bench_detail.json judged
+# against the archived runs/archive/BENCH_r*.json trajectory + the chaos
+# SLO line — schedule it right after a bench stage so the window
+# self-judges (typed verdict JSON to runs/regress.json; no device).
 for i in "${!STAGES[@]}"; do
   if [ "${STAGES[$i]}" = "soak_resume" ]; then
     STAGES[$i]="soak_resume,14400,runs/soak_resume.log,python tools/soak.py --config rm10 --audit"
   elif [ "${STAGES[$i]}" = "service_chaos" ]; then
     STAGES[$i]="service_chaos,1800,runs/service_chaos.log,python tools/service_chaos.py --seed 42 --jobs 3"
+  elif [ "${STAGES[$i]}" = "bench_regress" ]; then
+    # Outfile is a LOG, not runs/regress.json: the stage runner's stdout
+    # redirect truncates its outfile at start, which would destroy the
+    # previous atomically-written verdict if the stage dies early — the
+    # tool itself owns runs/regress.json via tmp+os.replace.
+    STAGES[$i]="bench_regress,300,runs/bench_regress.log,python tools/bench_regress.py"
   fi
 done
 
