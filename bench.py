@@ -111,6 +111,12 @@ def _audit(checker) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+#: This bench process's start, for concurrency checks against artifacts
+#: other tools write (a sweep that ended before we started never
+#: perturbed this run's measurement).
+_T0_UNIX = time.time()
+
+
 def _artifact_fresh(path: str) -> bool:
     """Whether a lint-family artifact is FRESH: newer than every package
     source file and the waiver file. An artifact older than any of its
@@ -197,6 +203,44 @@ def _journal_provenance() -> dict | None:
                 name: rep.get("journal")
                 for name, rep in line.get("scenarios", {}).items()
             },
+        }
+    except Exception:
+        return None
+
+
+def _fleet_provenance() -> dict | None:
+    """Fleet-service provenance from the latest runs/service_chaos.json
+    sweep (docs/service.md "Fleet"): device count and migration totals
+    across the scenarios — next to "journal"/"resume" so
+    tools/bench_regress.py can tell a clean line from one measured while
+    the fleet was migrating work between devices. None when the sweep
+    never ran in fleet mode (or is stale). `migrations` (bench_regress's
+    throughput-skip trigger, whose claim is "measured AMID failover")
+    only reports a sweep still writing after this bench started — an
+    older sweep is device/ok provenance, not a perturbation, and must
+    not permanently disable the regression gate."""
+    try:
+        path = os.path.join(RUNS, "service_chaos.json")
+        if not _artifact_fresh(path):
+            return None
+        concurrent = os.path.getmtime(path) >= _T0_UNIX
+        with open(path) as fh:
+            line = json.load(fh)
+        if not line.get("fleet_devices"):
+            return None
+        return {
+            "devices": line["fleet_devices"],
+            "ok": line.get("ok"),
+            "migrations": (
+                sum(
+                    (rep.get("fleet") or {}).get("migrations") or 0
+                    for rep in line.get("scenarios", {}).values()
+                )
+                if concurrent
+                else 0
+            ),
+            "concurrent": concurrent,
+            "sessions": line.get("sessions"),
         }
     except Exception:
         return None
@@ -700,6 +744,11 @@ def _worker(platform: str) -> None:
                     # service_chaos sweep's journal verdicts — records
                     # replayed and jobs re-adopted across restarts.
                     "journal": _journal_provenance(),
+                    # Fleet provenance (docs/service.md "Fleet"): device
+                    # count + migrations from the latest fleet-mode
+                    # sweep — bench_regress skips honestly on lines
+                    # measured amid cross-device migrations.
+                    "fleet": _fleet_provenance(),
                     # Perf-regression provenance (tools/bench_regress.py):
                     # the last gate verdict against the archived
                     # trajectory, when one exists. The gate runs AFTER a

@@ -51,6 +51,17 @@ Injection points (the seams; each is one hook call in the named owner):
 - ``lint.timeout`` — ``CheckerService._admission_verdict``: simulate the
   admission-lint subprocess timing out (the fail-open tooling-error
   path, counted as ``lint_errors``).
+- ``device.lost`` / ``device.flaky`` — consumed by
+  ``FleetService.submit`` (``service/fleet.py``). ``device.lost``
+  (params ``device`` = target index, default the device just routed to;
+  ``after_s`` = delay, default 1) counts successful PLACEMENTS — a
+  rejected submission can't swallow the seeded loss — and declares a
+  whole device dead mid-job: its pool's workers are killed, its jobs
+  evacuate and migrate to healthy siblings. ``device.flaky`` (params
+  ``depth``, ``once``) counts submission attempts (it injects into the
+  chaos dict the placement carries) and gives the routed job a one-shot
+  heartbeat-freeze on its device — the wedged-tunnel signature, per
+  device.
 
 ``STPU_CHAOS`` rides process boundaries by plain env inheritance: the
 service passes it (or its config's spec) into worker environments, so a
@@ -165,8 +176,13 @@ def plan() -> Optional[ChaosPlan]:
 
 def install(spec: Optional[str]) -> Optional[ChaosPlan]:
     """Explicitly install (or, with None, clear) the process-wide plan —
-    ``ServiceConfig(chaos=...)``'s path, and the tests'. Returns it."""
+    ``ServiceConfig(chaos=...)``'s path, and the tests'. Re-installing
+    the SAME spec keeps the live plan (and its fire counters): a fleet
+    installs once and its per-device pools' constructors must not reset
+    a schedule already in flight. Returns the plan."""
     global _PLAN, _RESOLVED
+    if spec and _RESOLVED and _PLAN is not None and _PLAN.spec == spec:
+        return _PLAN
     _RESOLVED = True
     _PLAN = ChaosPlan(spec) if spec else None
     return _PLAN

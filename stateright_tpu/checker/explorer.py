@@ -306,7 +306,37 @@ class ExplorerApp:
         own_label = self._job.id if self._job is not None else "interactive"
         samples += promexport.engine_samples(own, {"job": own_label})
         if self._service is not None:
-            samples += promexport.pool_samples(self._service.gauges())
+            gauges = self._service.gauges()
+            devices = gauges.get("devices") or {}
+            if devices:
+                # A fleet: pool families render ONLY as per-device
+                # labeled rows (an unlabeled aggregate repeating them
+                # would double PromQL sums — the per-device sums ARE the
+                # aggregates). Fleet-scoped state exports under its own
+                # stpu_fleet_* families: the fleet counters (submit
+                # dedup/rejection happen BEFORE any pool sees them, so
+                # per-device rows can't carry them), the fleet breaker
+                # verdict, fleet.jsonl position, and device counts.
+                from ..service.fleet import FLEET_COUNTERS
+
+                agg_keys = set().union(
+                    *(d.keys() for d in devices.values())
+                )
+                samples += promexport.pool_samples(
+                    {
+                        k: v for k, v in gauges.items()
+                        if k not in agg_keys
+                        or k in ("breaker", "journal")
+                        or k in FLEET_COUNTERS
+                    },
+                    prefix="stpu_fleet",
+                )
+                for device, dev_gauges in devices.items():
+                    samples += promexport.pool_samples(
+                        dev_gauges, {"device": device}
+                    )
+            else:
+                samples += promexport.pool_samples(gauges)
             for job in self._service.jobs():
                 if self._job is not None and job.id == self._job.id:
                     continue  # this session's checker is already rendered

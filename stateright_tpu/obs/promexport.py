@@ -68,6 +68,13 @@ COUNTER_KEYS = frozenset(
         "jobs_recovered",
         "orphans_killed",
         "artifacts_swept",
+        "jobs_evacuated",
+        # fleet counters (FLEET_COUNTERS; service/fleet.py)
+        "routed",
+        "migrations",
+        "devices_lost",
+        "device_flakes",
+        "host_last_resort",
     }
 )
 
@@ -124,32 +131,41 @@ def engine_samples(
     return out
 
 
-def pool_samples(gauges: Dict[str, Any]) -> List[Sample]:
-    """Flatten a ``service.gauges()`` snapshot into ``stpu_pool_*``
+def pool_samples(
+    gauges: Dict[str, Any],
+    labels: Optional[Dict[str, Any]] = None,
+    prefix: str = "stpu_pool",
+) -> List[Sample]:
+    """Flatten a ``service.gauges()`` snapshot into ``{prefix}_*``
     samples: occupancy counts, caps, the SERVICE_COUNTERS, breaker state
-    (``stpu_pool_breaker_open`` 0/1 + consecutive-wedge gauge), and the
-    journal position."""
+    (``{prefix}_breaker_open`` 0/1 + consecutive-wedge gauge), and the
+    journal position. ``labels`` ride every sample — the Explorer labels
+    a fleet's per-device pool rows ``device="device-K"`` — and fleet-
+    scoped rows render under ``prefix="stpu_fleet"`` so they never share
+    a family with (and double-count against) the per-device pool rows."""
     out: List[Sample] = []
-    lab: Dict[str, str] = {}
+    lab: Dict[str, str] = {
+        str(k): str(v) for k, v in (labels or {}).items() if v is not None
+    }
     for key, value in gauges.items():
         if key == "breaker" and isinstance(value, dict):
             out.append(
-                ("stpu_pool_breaker_open", lab, float(value.get("state") == "open"))
+                (f"{prefix}_breaker_open", lab, float(value.get("state") == "open"))
             )
             v = _numeric(value.get("consecutive_wedges"))
             if v is not None:
-                out.append(("stpu_pool_breaker_consecutive_wedges", lab, v))
+                out.append((f"{prefix}_breaker_consecutive_wedges", lab, v))
             continue
         if key == "journal" and isinstance(value, dict):
             v = _numeric(value.get("records"))
             if v is not None:
-                out.append(("stpu_pool_journal_records_total", lab, v))
+                out.append((f"{prefix}_journal_records_total", lab, v))
             continue
         v = _numeric(value)
         if v is None:
             continue
         name = (
-            f"stpu_pool_{key}_total" if key in COUNTER_KEYS else f"stpu_pool_{key}"
+            f"{prefix}_{key}_total" if key in COUNTER_KEYS else f"{prefix}_{key}"
         )
         out.append((name, lab, v))
     return out

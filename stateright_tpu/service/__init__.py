@@ -5,8 +5,10 @@ jobs — batch jobs in supervised worker subprocesses (per-job heartbeat,
 auto-checkpoint, span trace; a wedge quarantines one job, never the pool)
 and interactive Explorer sessions as registered in-process clients —
 behind admission control, with a breaker that degrades the pool to the
-host engine instead of dying. See ``docs/service.md``; chaos pins in
-``tests/test_service.py``.
+host engine instead of dying. :class:`FleetService` fronts N such pools
+— one per device — with least-loaded routing, per-device breaker state,
+and failover migration (``service/fleet.py``). See ``docs/service.md``;
+chaos pins in ``tests/test_service.py``.
 """
 
 from .core import (
@@ -16,12 +18,17 @@ from .core import (
     Job,
     ServiceConfig,
 )
+from .fleet import FLEET_COUNTERS, FleetConfig, FleetJob, FleetService
 from .journal import Journal, JournalTorn, read_journal
 from .registry import SHIPPED, resolve
 
 __all__ = [
     "AdmissionError",
     "CheckerService",
+    "FLEET_COUNTERS",
+    "FleetConfig",
+    "FleetJob",
+    "FleetService",
     "Job",
     "Journal",
     "JournalTorn",

@@ -100,6 +100,7 @@ SERVICE_COUNTERS = (
     "jobs_recovered",
     "orphans_killed",
     "artifacts_swept",
+    "jobs_evacuated",
 )
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
@@ -192,6 +193,32 @@ class ServiceConfig:
     #: layer the chaos/restart drills script (None: inherit env, which
     #: is a no-op when STPU_CHAOS is unset).
     chaos: Optional[str] = None
+    # -- fleet membership (service/fleet.py; docs/service.md "Fleet") ------
+    #: Device label this pool serves ("dev0"...). Rides every job
+    #: snapshot (and so /.pool and the dashboard's per-device rows);
+    #: None = the single-device pool's legacy surface.
+    device: Optional[str] = None
+    #: Device ordinal passed to workers as ``--device`` (worker.py pins
+    #: ``jax_default_device`` to ``jax.devices()[ordinal]``); None = the
+    #: backend default. On the 8-device virtual CPU mesh this is how a
+    #: fleet's pools land on distinct virtual devices.
+    device_ordinal: Optional[int] = None
+    #: Open-breaker policy. "host" (default, the single-pool contract):
+    #: jobs route to the host on-demand engine with ``degraded: true``.
+    #: "halt" (fleet pools): queued jobs HOLD while the breaker is open —
+    #: the FleetService migrates them to a healthy sibling device instead,
+    #: and only jobs force-submitted with ``engine="host"`` run (the
+    #: fleet's every-device-open last resort).
+    breaker_mode: str = "host"
+    #: Optional callable(state) notified (from a fresh thread, never under
+    #: the pool lock) when the breaker trips ("open") or closes
+    #: ("closed") — the fleet's migration trigger.
+    breaker_listener: Optional[Any] = None
+    #: TTL for Job.snapshot()'s memoized artifact-mtime ages: a 100-job
+    #: /.pool render (or a dashboard polling several endpoints in one
+    #: tick) does ONE stat per artifact per tick instead of one per
+    #: render.
+    snapshot_age_ttl_s: float = 1.0
 
 
 class Job:
@@ -216,8 +243,15 @@ class Job:
         self.spec = spec
         self.kind = kind  #: "batch" | "interactive"
         self.idempotency_key = idempotency_key
-        self.status = "queued"  #: queued|running|quarantined|done|failed
+        #: queued|running|quarantined|done|failed|migrated — "migrated" is
+        #: terminal FOR THIS POOL: the fleet evacuated the job to a
+        #: sibling device (service/fleet.py), which owns it from then on.
+        self.status = "queued"
         self.engine = "xla"  #: engine of the current/last attempt
+        self.engine_force: Optional[str] = None  #: "host" = fleet last resort
+        #: A sibling pool's checkpoint rotation to resume from when this
+        #: job has no checkpoint of its own yet (migration seed).
+        self.seed_checkpoint: Optional[str] = None
         self.degraded = False  #: served by the host fallback
         self.max_seconds = max_seconds
         self.max_states = max_states
@@ -238,6 +272,10 @@ class Job:
         self.checker = None  #: interactive jobs only
         self.dir: Optional[str] = None
         self._proc = None  #: live worker Popen (close-with-kill path)
+        self._attempt_t0: Optional[float] = None  #: monotonic; live attempt
+        #: path -> (age, read_at_monotonic): the snapshot() mtime memo
+        #: (snapshot_age_ttl_s).
+        self._age_cache: Dict[str, Any] = {}
 
     # -- paths -------------------------------------------------------------
 
@@ -260,7 +298,7 @@ class Job:
 
     @property
     def done(self) -> bool:
-        return self.status in ("done", "failed")
+        return self.status in ("done", "failed", "migrated")
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Blocks until the job reaches a terminal state; returns whether
@@ -276,6 +314,22 @@ class Job:
                 self._service._cond.wait(timeout=remaining)
         return True
 
+    def _cached_age(self, path: str) -> Optional[float]:
+        """``_mtime_age`` behind a ``snapshot_age_ttl_s`` memo: a 100-job
+        ``/.pool`` render (or several dashboard endpoints polled in one
+        tick) stats each artifact once per tick, not once per render."""
+        ttl = self._service._cfg.snapshot_age_ttl_s
+        now = time.monotonic()
+        hit = self._age_cache.get(path)
+        if hit is not None and now - hit[1] < ttl:
+            age = hit[0]
+            # The cached value drifts within the TTL; advance it so a
+            # frozen heartbeat still reads as aging between stats.
+            return None if age is None else round(age + (now - hit[1]), 3)
+        age = _mtime_age(path)
+        self._age_cache[path] = (age, now)
+        return age
+
     def snapshot(self) -> Dict[str, Any]:
         """The per-job status record (pool ``metrics()["jobs"]`` entry)."""
         out = {
@@ -285,6 +339,9 @@ class Job:
             "status": self.status,
             "engine": self.engine,
             "degraded": self.degraded,
+            # The device this pool serves (fleet pools; None on the
+            # single-device pool) — the dashboard's per-device grouping.
+            "device": self._service._cfg.device,
             "wedges": self.wedges,
             "requeues": self.requeues,
             "attempts": len(self.attempts),
@@ -296,11 +353,12 @@ class Job:
             # dashboard's per-job staleness + checkpoint-age readouts;
             # docs/observability.md "Dashboard"): None when the artifact
             # does not exist (host-engine jobs, swept dirs, heartbeat off).
+            # Memoized per poll tick (snapshot_age_ttl_s).
             "heartbeat_age_s": (
-                _mtime_age(self._path("hb.json")) if self.dir else None
+                self._cached_age(self._path("hb.json")) if self.dir else None
             ),
             "checkpoint_age_s": (
-                _mtime_age(self.checkpoint_path) if self.dir else None
+                self._cached_age(self.checkpoint_path) if self.dir else None
             ),
         }
         if self.result is not None:
@@ -329,6 +387,8 @@ class Job:
                 else None
             ),
             "engine": self.engine,
+            "engine_force": self.engine_force,
+            "seed_checkpoint": self.seed_checkpoint,
             "degraded": self.degraded,
             "consumed_s": self.consumed_s,
             "requeues": self.requeues,
@@ -443,8 +503,13 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "idempotency_key": rec.get("idempotency_key"),
                 "dir": rec.get("dir"),
                 "engine": "xla",
+                "engine_force": rec.get("engine_force"),
+                "seed_checkpoint": rec.get("seed_checkpoint"),
                 "degraded": False,
-                "consumed_s": 0.0,
+                # A migrated-in job arrives with wall-clock already spent
+                # on its previous device (spent_s rides the journal so a
+                # restart keeps charging it).
+                "consumed_s": float(rec.get("spent_s") or 0.0),
                 "requeues": 0,
                 "wedges": 0,
                 "error": None,
@@ -467,6 +532,13 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         if job is None:  # an event for a job the torn prefix never admitted
             continue
         if ev == "started":
+            if job["status"] == "migrated":
+                # The spawn/evacuate race can journal `started` after
+                # `evacuated` (the worker spawned in the window between
+                # the scheduler's pick and the evacuation sweep): the
+                # pool-terminal verdict wins — replay must not resurrect
+                # the evacuated job here, the sibling's journal owns it.
+                continue
             job["status"] = "running"
             job["started_ts"] = rec["ts"]
             job["pid"] = rec.get("pid")
@@ -493,6 +565,21 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             counters_inc(
                 "jobs_done" if rec["status"] == "done" else "jobs_failed"
             )
+        elif ev == "evacuated":
+            # The fleet moved this job to a sibling device: terminal for
+            # THIS pool — a restart must never requeue it here (the
+            # sibling's journal carries the live copy). The event carries
+            # the killed attempt's charge: a crash between `evacuated`
+            # and the fleet's `migrated` must not refund the budget the
+            # straggler repair resubmits with.
+            job["status"] = "migrated"
+            job["consumed_s"] = float(
+                rec.get("consumed_s", job["consumed_s"])
+            )
+            job["error"] = rec.get("reason")
+            job["completed_unix_ts"] = rec["ts"]
+            job["pid"] = None
+            counters_inc("jobs_evacuated")
         elif ev == "checkpointed":
             job["checkpointed"] = True
     return state
@@ -726,13 +813,15 @@ class CheckerService:
                     else None
                 )
                 job.engine = rec.get("engine", "xla")
+                job.engine_force = rec.get("engine_force")
+                job.seed_checkpoint = rec.get("seed_checkpoint")
                 job.degraded = bool(rec.get("degraded"))
                 job.consumed_s = float(rec.get("consumed_s", 0.0))
                 job.requeues = int(rec.get("requeues", 0))
                 job.wedges = int(rec.get("wedges", 0))
                 job.error = rec.get("error")
                 status = rec["status"]
-                if status in ("done", "failed"):
+                if status in ("done", "failed", "migrated"):
                     # Journal-complete: restore the terminal verdict,
                     # never re-run. The full result (discovery paths
                     # included) reloads from the job dir when the sweep
@@ -900,7 +989,7 @@ class CheckerService:
 
     def _counts(self) -> Dict[str, int]:
         c = {"queued": 0, "running": 0, "quarantined": 0, "interactive": 0,
-             "done": 0, "failed": 0}
+             "done": 0, "failed": 0, "migrated": 0}
         for j in self._jobs.values():
             if j.kind == "interactive":
                 if j.status == "running":
@@ -1044,6 +1133,9 @@ class CheckerService:
         max_states: Optional[int] = None,
         chaos: Optional[Dict[str, Any]] = None,
         idempotency_key: Optional[str] = None,
+        engine: str = "auto",
+        spent_s: float = 0.0,
+        resume_from: Optional[str] = None,
     ) -> Job:
         """Queues one batch checking job; returns its :class:`Job` handle
         or raises :class:`AdmissionError` (queue full → carries
@@ -1058,7 +1150,18 @@ class CheckerService:
         wants a genuine re-run picks a new key) with no admission
         accounting beyond the ``idem_dedups`` counter. This is what lets
         a supervisor restart loop blindly resubmit its whole schedule
-        after a service crash and converge to exactly-once."""
+        after a service crash and converge to exactly-once.
+
+        The fleet-migration knobs (service/fleet.py; docs/service.md
+        "Fleet"): ``engine="host"`` forces the host on-demand engine for
+        this job regardless of breaker state (the every-device-open last
+        resort — it is the only work a ``breaker_mode="halt"`` pool runs
+        while open); ``spent_s`` seeds the wall-clock already charged on
+        a previous device; ``resume_from`` seeds a sibling pool's
+        checkpoint rotation, adopted until this job writes rotations of
+        its own."""
+        if engine not in ("auto", "host"):
+            raise ValueError(f"engine must be 'auto' or 'host', got {engine!r}")
         registry.parse(spec)  # typed spec validation, pre-admission
         with self._lock:
             # Pre-flight closed check: a closed pool must reject
@@ -1155,8 +1258,16 @@ class CheckerService:
                 idempotency_key=idempotency_key,
             )
             job.lint = lint
+            job.engine_force = "host" if engine == "host" else None
+            job.consumed_s = max(0.0, float(spent_s))
+            job.seed_checkpoint = resume_from
             job.dir = os.path.join(self._ensure_session_dir(), job.id)
             os.makedirs(job.dir, exist_ok=True)
+            if job.chaos.get("marker") is True:
+                # The "arm exactly-once" sentinel for caller-supplied
+                # chaos dicts (the fleet's device.flaky): resolved to a
+                # per-job marker path now that the job dir exists.
+                job.chaos["marker"] = os.path.join(job.dir, "chaos.marker")
             # Pool-level chaos plan -> job-level worker sabotage: the
             # N-th submitted job (the plan's @n trigger counts submits)
             # gets the matching worker flag. `once` (default) arms the
@@ -1186,6 +1297,9 @@ class CheckerService:
                 chaos=job.chaos or None,
                 idempotency_key=idempotency_key,
                 dir=os.path.relpath(job.dir, self._cfg.run_dir),
+                engine_force=job.engine_force,
+                spent_s=job.consumed_s or None,
+                seed_checkpoint=job.seed_checkpoint,
             )
             self._jlog(
                 "admitted",
@@ -1271,10 +1385,20 @@ class CheckerService:
                 counts = self._counts()
                 slots = self._cfg.max_inflight - counts["running"]
                 quarantine_release = None
+                # Halt mode (fleet pools): while the breaker is open,
+                # queued jobs HOLD for the fleet to migrate them — only
+                # forced-host work (the all-devices-open last resort)
+                # runs. The breaker close notifies, re-waking this loop.
+                halted = (
+                    self._cfg.breaker_mode == "halt"
+                    and self._breaker == "open"
+                )
                 if slots > 0:
                     for jid in self._order:
                         job = self._jobs[jid]
                         if job.kind != "batch":
+                            continue
+                        if halted and job.engine_force != "host":
                             continue
                         if job.status == "quarantined" and job.requeue_at > now:
                             quarantine_release = (
@@ -1342,6 +1466,9 @@ class CheckerService:
         except Exception as e:  # noqa: BLE001 - the verdict IS the handling
             with self._cond:
                 job._proc = None
+                if job.status == "migrated":  # the fleet owns it now
+                    self._cond.notify_all()
+                    return
                 job.status = "failed"
                 job.error = f"supervisor error: {type(e).__name__}: {e}"
                 job.completed_unix_ts = time.time()
@@ -1354,8 +1481,29 @@ class CheckerService:
 
     def _run_job_inner(self, job: Job) -> None:
         cfg = self._cfg
+        with self._cond:
+            if job.status == "migrated":
+                # Evacuated between the scheduler's pick and this
+                # attempt: the sibling pool owns the job now — spawning
+                # a worker here would run the condemned device anyway
+                # (and settle/charge a job this pool no longer owns).
+                self._cond.notify_all()
+                return
         attempt = len(job.attempts)
-        device = self._breaker == "closed"
+        device = self._breaker == "closed" and job.engine_force != "host"
+        if (
+            not device
+            and job.engine_force != "host"
+            and cfg.breaker_mode == "halt"
+        ):
+            # Halt-mode race guard: the breaker tripped between the
+            # scheduler's pick and here. Re-queue for the fleet to
+            # migrate instead of silently degrading to the host engine.
+            with self._cond:
+                if job.status == "running":
+                    job.status = "queued"
+                self._cond.notify_all()
+            return
         engine = "xla" if device else "host"
         remaining = job.max_seconds - job.consumed_s
         if remaining <= 0:
@@ -1373,6 +1521,10 @@ class CheckerService:
         resume = (
             latest_valid_checkpoint(job.checkpoint_path) if device else None
         )
+        if resume is None and device and job.seed_checkpoint:
+            # Migration seed: no rotation of our own yet — adopt (and
+            # re-verify) the sibling pool's rotation the fleet handed us.
+            resume = latest_valid_checkpoint(job.seed_checkpoint)
         argv = [
             sys.executable, _WORKER,
             "--spec", job.spec,
@@ -1389,6 +1541,8 @@ class CheckerService:
                 "--keep", str(cfg.checkpoint_keep),
                 "--metrics", job.metrics_path,
             ]
+            if cfg.device_ordinal is not None:
+                argv += ["--device", str(cfg.device_ordinal)]
             if resume:
                 argv += ["--resume", resume]
         if job.max_states:
@@ -1412,11 +1566,16 @@ class CheckerService:
             with self._cond:
                 job._proc = proc
                 closed = self._closed
-                self._jlog(
-                    "started", job=job.id, attempt=attempt, engine=engine,
-                    resumed_from=resume, pid=proc.pid,
-                )
-            if closed:
+                migrated = job.status == "migrated"
+                if not migrated:
+                    # An evacuated job must not append `started` after
+                    # its `evacuated` record: replay would read the
+                    # journal-ordering race as a live attempt.
+                    self._jlog(
+                        "started", job=job.id, attempt=attempt,
+                        engine=engine, resumed_from=resume, pid=proc.pid,
+                    )
+            if closed or migrated:
                 sup._kill_group(proc)
 
         with self._cond:
@@ -1426,8 +1585,14 @@ class CheckerService:
                 self._counters.inc("jobs_failed")
                 self._cond.notify_all()
                 return
+            if job.status == "migrated":
+                # Evacuate raced us between the top-of-attempt check and
+                # here: the sibling owns the job — don't spawn.
+                self._cond.notify_all()
+                return
             job.engine = engine
             job.resumed_from = resume
+            job._attempt_t0 = time.monotonic()
             if not device:
                 job.degraded = True
         self.log(f"{job.id} attempt {attempt} engine={engine} resume={resume}")
@@ -1461,6 +1626,14 @@ class CheckerService:
                 result = None
         with self._cond:
             job._proc = None
+            job._attempt_t0 = None
+            if job.status == "migrated":
+                # The fleet evacuated this job while its worker ran (and
+                # killed the worker group): the sibling pool owns it now —
+                # no settlement, no budget charge (evacuate() already
+                # captured the live attempt's wall-clock), no requeue.
+                self._cond.notify_all()
+                return
             # Wedge time is the DEVICE's fault, not the tenant's demand:
             # charging it would make the requeued attempt start with a
             # drained budget and fail as "budget exhausted" instead of
@@ -1543,10 +1716,25 @@ class CheckerService:
         self, job: Job, reason: str, *, wedged: bool = False
     ) -> None:
         """Quarantine-and-requeue with exponential backoff, up to the
-        requeue limit. Caller holds the lock."""
-        if job.requeues < self._cfg.requeue_limit:
-            job.requeues += 1
-            self._counters.inc("requeues")
+        requeue limit. Caller holds the lock.
+
+        Halt-mode override: a WEDGE at the requeue limit while the
+        breaker is open does not fail the job — the device is the
+        condemned party, not the tenant, and the fleet is about to
+        migrate the pool's jobs to healthy silicon. The job holds
+        quarantined (no extra requeue charged) for evacuation; crashes
+        and every verdict on a closed breaker keep the single-pool
+        contract."""
+        hold = (
+            wedged
+            and self._cfg.breaker_mode == "halt"
+            and self._breaker == "open"
+            and job.requeues >= self._cfg.requeue_limit
+        )
+        if job.requeues < self._cfg.requeue_limit or hold:
+            if not hold:
+                job.requeues += 1
+                self._counters.inc("requeues")
             job.status = "quarantined"
             delay = sup.backoff_delay(job.requeues, self._cfg.backoff_s)
             job.requeue_at = time.monotonic() + delay
@@ -1579,7 +1767,67 @@ class CheckerService:
                 error=job.error, result=None,
             )
 
+    # -- fleet migration (service/fleet.py) --------------------------------
+
+    def evacuate(self, *, reason: str = "device lost") -> List[Job]:
+        """Reclassify every non-terminal batch job as ``migrated`` —
+        terminal for THIS pool, journaled as ``evacuated`` so a pool
+        restart never requeues it here — and kill any live worker process
+        group. Returns the evacuated jobs; each carries everything a
+        healthy sibling pool needs to resume it (spec, budgets,
+        ``consumed_s`` updated with the live attempt's wall-clock,
+        requeue history, and checkpoint rotations still on disk in its
+        job dir). The FleetService is the only intended caller: it
+        resubmits each to a sibling with ``spent_s=``/``resume_from=``."""
+        procs = []
+        out: List[Job] = []
+        now = time.monotonic()
+        with self._cond:
+            for jid in self._order:
+                job = self._jobs[jid]
+                if job.kind != "batch" or job.done:
+                    continue
+                if job.engine_force == "host":
+                    # Forced-host work is device-independent: killing it
+                    # would discard progress no checkpoint can restore
+                    # (host attempts don't checkpoint) for zero safety
+                    # gain — the dead device was never involved.
+                    continue
+                if job.status == "running" and job._attempt_t0 is not None:
+                    # The live attempt's spend: run_worker has not
+                    # returned (we are about to kill it), so charge the
+                    # elapsed wall-clock here — the sibling must not get
+                    # a budget refund out of the migration.
+                    job.consumed_s += max(0.0, now - job._attempt_t0)
+                    job._attempt_t0 = None
+                if job._proc is not None and job._proc.poll() is None:
+                    procs.append(job._proc)
+                job.status = "migrated"
+                job.error = reason
+                job.completed_unix_ts = time.time()
+                self._counters.inc("jobs_evacuated")
+                self._jlog(
+                    "evacuated", job=job.id, reason=reason,
+                    consumed_s=job.consumed_s,
+                )
+                out.append(job)
+            self._cond.notify_all()
+        for proc in procs:
+            sup._kill_group(proc)
+        return out
+
     # -- breaker -----------------------------------------------------------
+
+    def _notify_breaker_listener(self, state: str) -> None:
+        """Fire the fleet's breaker listener from a fresh thread — the
+        trip/close sites hold the pool lock, and the listener (migration
+        scheduling) takes fleet locks of its own."""
+        listener = self._cfg.breaker_listener
+        if listener is not None:
+            threading.Thread(
+                target=listener, args=(state,),
+                name="stpu-breaker-listener", daemon=True,
+            ).start()
 
     def _record_wedge(self) -> None:
         """Caller holds the lock."""
@@ -1596,8 +1844,14 @@ class CheckerService:
             )
             self.log(
                 f"breaker OPEN after {self._consecutive_wedges} consecutive "
-                "wedge verdicts; routing jobs to the host engine"
+                "wedge verdicts; "
+                + (
+                    "holding queued jobs for fleet migration"
+                    if self._cfg.breaker_mode == "halt"
+                    else "routing jobs to the host engine"
+                )
             )
+            self._notify_breaker_listener("open")
             if self._cfg.probe_auto:
                 self._start_prober()
 
@@ -1627,6 +1881,7 @@ class CheckerService:
         except (subprocess.TimeoutExpired, OSError):
             rc = None
         ok = rc == 0
+        closed_now = False
         with self._cond:
             if ok and self._breaker == "open":
                 self._breaker = "closed"
@@ -1635,7 +1890,10 @@ class CheckerService:
                 self._counters.inc("breaker_closes")
                 self._jlog("breaker_closed")
                 self.log("breaker CLOSED (device probe healthy)")
+                closed_now = True
                 self._cond.notify_all()
+        if closed_now:
+            self._notify_breaker_listener("closed")
         return ok
 
     def _probe_loop(self) -> None:
@@ -1683,6 +1941,7 @@ class CheckerService:
             counts = self._counts()
             return {
                 **counts,
+                "device": self._cfg.device,
                 "max_inflight": self._cfg.max_inflight,
                 "max_queue": self._cfg.max_queue,
                 "max_sessions": self._cfg.max_sessions,

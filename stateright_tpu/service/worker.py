@@ -78,6 +78,13 @@ def main() -> int:
     p.add_argument("--spec", required=True)  # service/registry.py grammar
     p.add_argument("--engine", default="xla", choices=("xla", "host"))
     p.add_argument("--platform", default="default")  # "default" | "cpu"
+    # Fleet device pinning (ServiceConfig.device_ordinal): run this job's
+    # engine on jax.devices()[N] — a fleet's per-device pools land their
+    # workers on distinct devices of the mesh. Out-of-range ordinals fall
+    # back to the backend default (recorded in the result) rather than
+    # failing the job: a fleet restarted on a smaller mesh must still
+    # drain its journal.
+    p.add_argument("--device", type=int, default=None)
     p.add_argument("--out", required=True)
     p.add_argument("--checkpoint", default=None)  # auto-checkpoint base
     p.add_argument("--metrics", default=None)  # metrics time-series base
@@ -99,6 +106,13 @@ def main() -> int:
         # sitecustomize pins the accelerator plugin at config level).
         jax.config.update("jax_platforms", "cpu")
     _enable_compile_cache()
+
+    device_label = None
+    if args.device is not None and args.engine == "xla":
+        devices = jax.devices()
+        if 0 <= args.device < len(devices):
+            jax.config.update("jax_default_device", devices[args.device])
+            device_label = str(devices[args.device])
 
     from stateright_tpu.service.registry import resolve
 
@@ -196,6 +210,8 @@ def main() -> int:
         "spec": args.spec,
         "engine": args.engine,
         "platform": jax.default_backend(),
+        "device": device_label,
+        "device_ordinal": args.device,
         "degraded": args.engine == "host",
         "generated": checker.state_count(),
         "unique": checker.unique_state_count(),

@@ -131,7 +131,9 @@ function renderPool(pool) {
   el("pool-tiles").innerHTML = tiles.map(([k, v]) =>
     `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`
   ).join("") + `<div class="tile"><div class="v">${breakerBadge(pool.breaker)}</div>` +
-    `<div class="k">device</div></div>` +
+    `<div class="k">${pool.fleet ? "fleet" : "device"}</div></div>` +
+    (pool.fleet ? `<div class="tile"><div class="v">${fmt(pool.migrations)}</div>` +
+      `<div class="k">migrations</div></div>` : "") +
     (pool.journal ? `<div class="tile"><div class="v">${fmt(pool.journal.records)}</div>` +
       `<div class="k">journal records</div></div>` : "");
 
@@ -148,6 +150,33 @@ function renderPool(pool) {
   const rows = sparks.querySelectorAll(".spark");
   sparkline(rows[0].querySelector(".plot"), queueRing.map((r) => r.queued));
   sparkline(rows[1].querySelector(".plot"), queueRing.map((r) => r.running));
+}
+
+// --- devices (fleet pools; service/fleet.py) -------------------------------
+
+function deviceBadge(dev) {
+  if (dev.lost)
+    return `<span class="badge serious"><span class="dot"></span>LOST</span>`;
+  const open = dev.breaker && dev.breaker.state === "open";
+  return open
+    ? `<span class="badge warning"><span class="dot"></span>breaker open</span>`
+    : `<span class="badge good"><span class="dot"></span>healthy</span>`;
+}
+
+function renderDevices(devices) {
+  const holder = el("devices");
+  if (!holder) return;
+  if (!devices) { holder.innerHTML = ""; return; }
+  holder.innerHTML = Object.keys(devices).map((name) => {
+    const d = devices[name];
+    return `<div class="tile device"><h3><span class="mono">${escapeHtml(name)}</span>` +
+      `${deviceBadge(d)}</h3>` +
+      `<div class="meta mono">run ${fmt(d.running)} · queue ${fmt((d.queued || 0) + (d.quarantined || 0))}` +
+      ` · done ${fmt(d.jobs_done)}` +
+      (d.jobs_evacuated ? ` · evac ${fmt(d.jobs_evacuated)}` : "") +
+      (d.wedge_verdicts ? ` · wedges ${fmt(d.wedge_verdicts)}` : "") +
+      `</div></div>`;
+  }).join("");
 }
 
 // --- jobs ------------------------------------------------------------------
@@ -249,6 +278,7 @@ async function poll() {
       const pool = await res.json();
       el("pool-error").textContent = "";
       renderPool(pool);
+      renderDevices(pool.devices || null);
       renderJobs(pool.jobs || {});
       return;
     }

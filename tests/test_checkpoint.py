@@ -298,6 +298,57 @@ def test_autockpt_sharded_then_single_chip_resume(tmp_path):
     assert resumed.unique_state_count() == 1_568
 
 
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device mesh")
+def test_autockpt_single_chip_then_sharded_resume(tmp_path):
+    """The REVERSE auto-checkpoint direction ROADMAP item 2 left open
+    (sharded-auto -> single resume is pinned above): a single-chip run's
+    in-loop auto-checkpoint rotations resume on the SHARDED mesh engine
+    — which then keeps auto-checkpointing rotations of its own — with
+    exact full-coverage counts and identical discoveries."""
+    path = str(tmp_path / "chip_auto.npz")
+    partial = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        levels_per_dispatch=1,
+        checkpoint_to=path, checkpoint_every=1, checkpoint_keep=3,
+    )
+    for _ in range(5):  # part-way through the 14-level space
+        partial._run_block()
+    assert partial.metrics()["checkpoints_written"] >= 3
+    latest = ck_mod.latest_valid_checkpoint(path)
+    assert latest is not None
+
+    mesh_path = str(tmp_path / "mesh_auto.npz")
+    resumed = PackedTwoPhaseSys(4).checker().spawn_xla(
+        mesh=default_mesh(8),
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        levels_per_dispatch=1,
+        checkpoint=latest,
+        checkpoint_to=mesh_path, checkpoint_every=1,
+    )
+    assert resumed.state_count() == partial.state_count()
+    assert resumed.unique_state_count() == partial.unique_state_count()
+    resumed.join()
+    assert resumed.state_count() == 8_258
+    assert resumed.unique_state_count() == 1_568
+    assert resumed.metrics()["resumed_from"] == latest
+    ref = _full_run_reference()
+    assert resumed.max_depth() == ref.max_depth()
+    assert set(resumed.discoveries()) == set(ref.discoveries())
+    resumed.assert_properties()
+    # The mesh leg auto-checkpointed rotations of its own, and the
+    # newest one round-trips BACK onto the single-chip engine — the
+    # full chip -> mesh -> chip recovery cycle is closed.
+    assert resumed.metrics()["checkpoints_written"] >= 1
+    mesh_latest = ck_mod.latest_valid_checkpoint(mesh_path)
+    assert mesh_latest is not None
+    back = PackedTwoPhaseSys(4).checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 13,
+        checkpoint=mesh_latest,
+    ).join()
+    assert back.state_count() == 8_258
+    assert back.unique_state_count() == 1_568
+
+
 def test_checkpoint_preserves_discovery_pins(tmp_path):
     # Run to completion (both sometimes-properties found), checkpoint, and
     # resume: the resumed checker must report the same witnesses without

@@ -227,6 +227,64 @@ def test_metrics_endpoint_without_service():
     assert seqs == sorted(seqs) and len(set(seqs)) == 3
 
 
+def test_fleet_metrics_per_device_rows_no_double_count(tmp_path):
+    """A fleet /.metrics exposition renders the pool families ONLY as
+    per-device labeled rows — an unlabeled aggregate repeating them
+    would make a PromQL ``sum`` over the family double-count — while
+    fleet-scoped state (the fleet counters, which fire before any pool
+    is touched; the fleet breaker verdict; fleet.jsonl position) exports
+    under its own ``stpu_fleet_*`` families."""
+    from stateright_tpu.service import FleetConfig, FleetService
+
+    fleet = FleetService(FleetConfig(
+        run_dir=str(tmp_path / "fleet"),
+        devices=2,
+        pool=ServiceConfig(
+            platform="cpu", max_inflight=0,
+            probe_auto=False, admission_lint=False,
+        ),
+    ))
+    try:
+        fleet.submit("2pc:3", idempotency_key="m1")
+        fleet.submit("2pc:3", idempotency_key="m1")  # fleet-level dedup
+        app, checker = make_app(MODEL.checker(), service=fleet, **KW)
+        try:
+            parsed = pe.parse_openmetrics(app.metrics_text())
+            dev0 = frozenset({("device", "device-0")})
+            dev1 = frozenset({("device", "device-1")})
+            assert ("stpu_pool_queued", dev0) in parsed
+            assert ("stpu_pool_queued", dev1) in parsed
+            # No unlabeled duplicate of a per-device family: the family
+            # sum IS the truth (one queued batch job fleet-wide).
+            assert ("stpu_pool_queued", frozenset()) not in parsed
+            assert sum(
+                v for (n, labs), v in parsed.items()
+                if n == "stpu_pool_queued"
+            ) == 1
+            assert sum(
+                v for (n, labs), v in parsed.items()
+                if n == "stpu_pool_interactive"
+            ) == 1  # this session, on exactly one device
+            # Fleet-scoped rows render under their own families — incl.
+            # the counters no per-device row can carry (the fleet-level
+            # idempotency dedup never reached a pool).
+            assert parsed[("stpu_fleet_routed_total", frozenset())] == 1
+            assert parsed[("stpu_fleet_idem_dedups_total", frozenset())] == 1
+            assert parsed[("stpu_fleet_submitted_total", frozenset())] >= 2
+            assert parsed[("stpu_fleet_device_count", frozenset())] == 2
+            assert ("stpu_fleet_breaker_open", frozenset()) in parsed
+            assert ("stpu_pool_breaker_open", dev0) in parsed
+            # The aggregated occupancy sums are NOT re-exported under
+            # stpu_fleet_* either (derivable from the per-device rows).
+            assert not any(
+                n == "stpu_fleet_queued" for n, _ in parsed
+            )
+        finally:
+            app.close()
+    finally:
+        fleet.close()
+
+
 def test_http_end_to_end(tmp_path):
     """The real socket path: /.metrics content type + parse, the
     dashboard assets, and the windowed series endpoint with ?n=."""

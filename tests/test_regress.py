@@ -108,6 +108,21 @@ def test_honest_skips(tmp_path):
     tp = [c for c in line["checks"] if c["name"] == "throughput"][0]
     assert tp["verdict"] == "skip"
     assert line["verdict"] == "pass"
+    # A line whose fleet provenance records cross-device migrations was
+    # measured amid failover evacuations: skip, not fail — and a
+    # migration-free fleet line stays judged normally.
+    line = br.judge(
+        _fresh(5_000.0, fleet={"devices": 2, "migrations": 3}), traj, None
+    )
+    tp = [c for c in line["checks"] if c["name"] == "throughput"][0]
+    assert tp["verdict"] == "skip"
+    assert "migration" in tp["detail"]
+    assert line["verdict"] == "pass"
+    line = br.judge(
+        _fresh(100_000.0, fleet={"devices": 2, "migrations": 0}), traj, None
+    )
+    assert [c for c in line["checks"] if c["name"] == "throughput"][0][
+        "verdict"] == "fail"
     # A platform with no archived line yet: skip, not fail (banking the
     # first chip line STARTS that trajectory).
     tpu = br.normalize_fresh(

@@ -28,12 +28,16 @@ service's ``platform="cpu"`` knob.
 
 import json
 import os
+import threading
+import time
 
 import pytest
 
 from stateright_tpu.service import (
     AdmissionError,
     CheckerService,
+    FleetConfig,
+    FleetService,
     ServiceConfig,
 )
 
@@ -43,6 +47,18 @@ PINNED = {
     "2pc:4": (8_258, 1_568),
     "scr:3,1": (6_778, 4_243),
 }
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos(monkeypatch):
+    """Each test starts (and ends) with no installed chaos plan — the
+    fleet failover smoke installs one process-wide."""
+    from stateright_tpu import chaos as chaos_mod
+
+    monkeypatch.delenv("STPU_CHAOS", raising=False)
+    chaos_mod.install(None)
+    yield
+    chaos_mod.install(None)
 
 
 def _config(tmp_path, **kw):
@@ -370,6 +386,295 @@ def test_breaker_trip_host_fallback_and_recovery(tmp_path):
         assert g["breaker_closes"] == 1
         assert g["degraded_jobs"] == 1
         assert not svc.degraded
+    finally:
+        svc.close()
+
+
+# --- fleet: multi-device pools, failover migration (ISSUE 15) --------------
+
+
+def _fleet(tmp_path, devices=2, pool_kw=None, **kw):
+    pool = _config(tmp_path)  # run_dir is overwritten per device
+    if pool_kw:
+        for k, v in pool_kw.items():
+            setattr(pool, k, v)
+    base = dict(
+        run_dir=str(tmp_path / "fleet"),
+        devices=devices,
+        monitor_interval_s=0.3,
+        pool=pool,
+    )
+    base.update(kw)
+    return FleetService(FleetConfig(**base))
+
+
+def test_smoke_fleet_failover(tmp_path):
+    """The <30s fleet tier-0 drill (tools/smoke.sh; ISSUE 15 acceptance):
+    a 2-device fleet, `device.lost@n=1` kills the first routed job's
+    device mid-job — the victim migrates to the surviving device and
+    completes with counts bit-identical to an undisturbed run, while the
+    sibling job (on the survivor) never notices."""
+    fleet = _fleet(
+        tmp_path, devices=2,
+        chaos="seed=1;device.lost@n=1:after_s=2",
+    )
+    try:
+        victim = fleet.submit("2pc:3")
+        sibling = fleet.submit("2pc:3")
+        first_device = victim.device
+        assert {victim.device, sibling.device} == {0, 1}  # least-loaded spread
+        assert fleet.wait_all(timeout=240), fleet.metrics()
+
+        assert victim.status == "done", (victim.status, victim.error)
+        assert len(victim.migrations) == 1
+        assert victim.device != first_device  # finished on the survivor
+        _assert_exact(victim.result, "2pc:3")
+
+        assert sibling.status == "done", (sibling.status, sibling.error)
+        assert sibling.migrations == []
+        _assert_exact(sibling.result, "2pc:3")
+
+        g = fleet.gauges()
+        assert g["migrations"] == 1
+        assert g["devices_lost"] == 1
+        assert g["lost_devices"] == [first_device]
+        assert g["jobs_evacuated"] == 1
+        # The lost device's pool journaled the evacuation (terminal for
+        # that pool — a restart would never requeue the job there).
+        assert g["devices"][f"device-{first_device}"]["lost"] is True
+        # Both fleet jobs' snapshots carry their device.
+        snap = fleet.metrics()["jobs"][victim.id]
+        assert snap["device"] == f"device-{victim.device}"
+        assert snap["migrations"] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_host_last_resort_only_when_all_open(tmp_path):
+    """ISSUE 15 acceptance pin: host-engine degradation happens ONLY when
+    every device breaker is open/lost — one healthy sibling means device
+    routing, never the host fallback. Routing-only (disarmed pools)."""
+    fleet = _fleet(tmp_path, devices=2, pool_kw={"max_inflight": 0})
+    try:
+        # Device 0's breaker open: routing must pick the healthy sibling
+        # on the DEVICE engine — not degrade.
+        with fleet.pools[0]._cond:
+            fleet.pools[0]._breaker = "open"
+        job = fleet.submit("2pc:3")
+        assert job.device == 1
+        assert job.pool_job.engine_force is None
+        assert not fleet.degraded
+        assert fleet.gauges()["host_last_resort"] == 0
+
+        # Every breaker open: now — and only now — the host last resort.
+        with fleet.pools[1]._cond:
+            fleet.pools[1]._breaker = "open"
+        assert fleet.degraded
+        last = fleet.submit("2pc:3")
+        assert last.pool_job.engine_force == "host"
+        assert fleet.gauges()["host_last_resort"] == 1
+        assert fleet.gauges()["breaker"]["state"] == "open"
+
+        # A closed breaker restores device routing immediately.
+        with fleet.pools[0]._cond:
+            fleet.pools[0]._breaker = "closed"
+        healthy_again = fleet.submit("2pc:3")
+        assert healthy_again.device == 0
+        assert healthy_again.pool_job.engine_force is None
+    finally:
+        fleet.close()
+
+
+def test_fleet_idempotency_and_admission(tmp_path):
+    fleet = _fleet(tmp_path, devices=2,
+                   pool_kw={"max_inflight": 0, "max_queue": 1})
+    try:
+        a = fleet.submit("2pc:3", idempotency_key="k1")
+        assert fleet.submit("2pc:3", idempotency_key="k1") is a
+        assert fleet.gauges()["idem_dedups"] == 1
+        # Capacity = 1 queued per device; past both, the typed rejection
+        # carries the minimum Retry-After across devices.
+        fleet.submit("2pc:3")
+        with pytest.raises(AdmissionError) as exc:
+            fleet.submit("2pc:3")
+        assert exc.value.retry_after_s is not None
+        # Over-cap budgets reject identically on every device: no retry
+        # hint, and the fleet does not waste submissions on siblings.
+        with pytest.raises(AdmissionError) as exc:
+            fleet.submit("2pc:3", max_seconds=10_000_000.0)
+        assert exc.value.retry_after_s is None
+    finally:
+        fleet.close()
+
+
+def test_fleet_concurrent_same_key_submits_dedupe(tmp_path):
+    """The fleet-scoped idempotency reservation: concurrent same-key
+    submits dedupe to ONE FleetJob (the key reserves under the lock
+    BEFORE routing, so the race cannot place the same work on two
+    devices) — and a fleet-wide rejection unwinds the reservation so
+    the key can be retried."""
+    fleet = _fleet(tmp_path, devices=2, pool_kw={"max_inflight": 0})
+    try:
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    fleet.submit("2pc:3", idempotency_key="kc")
+                )
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 4
+        assert len({id(r) for r in results}) == 1
+        assert sum(
+            1 for j in fleet.jobs() if j.idempotency_key == "kc"
+        ) == 1
+        assert fleet.gauges()["idem_dedups"] == 3
+        # Rejection unwind: an over-budget submit fails on every device,
+        # the reservation is removed, and the key stays retryable.
+        with pytest.raises(AdmissionError):
+            fleet.submit("2pc:3", idempotency_key="kr",
+                         max_seconds=10_000_000.0)
+        assert all(j.idempotency_key != "kr" for j in fleet.jobs())
+        retry = fleet.submit("2pc:3", idempotency_key="kr")
+        assert retry.pool_job is not None
+        # Concurrent submits started exactly ONE monitor thread.
+        assert sum(
+            1 for t in threading.enumerate()
+            if t.name == "stpu-fleet-monitor" and t.is_alive()
+        ) == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_submit_unwinds_on_non_admission_errors(tmp_path):
+    """A non-admission failure mid-routing (malformed spec → ValueError
+    from registry.parse) must not leak the reserved handle as a
+    permanently-queued zombie FleetJob: the reservation unwinds, the
+    caller sees the original error, and the key stays retryable."""
+    fleet = _fleet(tmp_path, devices=2, pool_kw={"max_inflight": 0})
+    try:
+        with pytest.raises(ValueError):
+            fleet.submit("not-a-spec", idempotency_key="kz")
+        assert fleet.jobs() == []
+        assert fleet.gauges()["rejected"] == 1
+        good = fleet.submit("2pc:3", idempotency_key="kz")
+        assert good.pool_job is not None
+    finally:
+        fleet.close()
+
+
+def _live_monitors():
+    return [
+        t for t in threading.enumerate()
+        if t.name == "stpu-fleet-monitor" and t.is_alive()
+    ]
+
+
+def test_fleet_monitor_idle_exits_and_restarts(tmp_path):
+    """The monitor thread exits once every fleet job is terminal (no
+    forever-sweep of every pool's locks on a long-lived fleet) and comes
+    back on the next submit — and the idle check itself must not
+    deadlock on the fleet lock (it runs under it; FleetJob.done would
+    re-acquire)."""
+    fleet = _fleet(tmp_path, devices=2)
+    try:
+        fleet.submit("2pc:3")
+        assert fleet.wait_all(timeout=240), fleet.metrics()
+        deadline = time.monotonic() + 10.0
+        while _live_monitors() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not _live_monitors()  # idle-exited
+        again = fleet.submit("2pc:3")
+        assert _live_monitors()  # submit brought it back
+        assert again.wait(timeout=240)
+        assert again.status == "done"
+    finally:
+        fleet.close()
+
+
+def test_evacuate_skips_forced_host_jobs(tmp_path):
+    """Forced-host work is device-independent: losing the device must
+    not kill it (host attempts don't checkpoint — evacuation would
+    discard the progress for zero safety gain)."""
+    svc = CheckerService(_config(tmp_path, max_inflight=0))
+    try:
+        host_job = svc.submit("2pc:3", engine="host")
+        dev_job = svc.submit("2pc:3")
+        out = svc.evacuate(reason="device lost")
+        assert [j.id for j in out] == [dev_job.id]
+        assert dev_job.status == "migrated"
+        assert host_job.status == "queued"  # rides out the outage
+    finally:
+        svc.close()
+
+
+def test_fleet_session_cap_holds_under_concurrent_registration(tmp_path):
+    """The fleet-wide max_sessions cap is atomic with registration: N
+    concurrent register_interactive calls against a cap of 1 admit
+    exactly one session — the rest reject typed (the per-pool caps alone
+    would have let several through)."""
+    import types
+
+    fleet = _fleet(tmp_path, devices=2, max_sessions=1,
+                   pool_kw={"max_inflight": 0, "max_sessions": 4})
+    try:
+        admitted, rejected = [], []
+
+        def grab():
+            checker = types.SimpleNamespace(
+                model=lambda: object(), attach_job=lambda jid: None
+            )
+            try:
+                admitted.append(
+                    fleet.register_interactive(checker, label="swarm")
+                )
+            except AdmissionError as e:
+                rejected.append(e)
+
+        threads = [threading.Thread(target=grab) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(admitted) == 1
+        assert len(rejected) == 5
+        assert all(e.retry_after_s is not None for e in rejected)
+        assert fleet.gauges()["interactive"] == 1
+        fleet.release_interactive(admitted[0])
+        assert fleet.gauges()["interactive"] == 0
+    finally:
+        fleet.close()
+
+
+def test_job_snapshot_memoizes_artifact_ages(tmp_path, monkeypatch):
+    """ISSUE 15 satellite: snapshot()'s heartbeat/checkpoint ages stat
+    each artifact once per poll tick (snapshot_age_ttl_s), not once per
+    render — and the snapshot surfaces the pool's device."""
+    from stateright_tpu.service import core as svc_core
+
+    svc = CheckerService(_config(tmp_path, max_inflight=0, device="dev7"))
+    try:
+        job = svc.submit("2pc:3")
+        with open(os.path.join(job.dir, "hb.json"), "w") as fh:
+            fh.write("{}")
+        calls = []
+        real = svc_core._mtime_age
+        monkeypatch.setattr(
+            svc_core, "_mtime_age", lambda p: calls.append(p) or real(p)
+        )
+        first = job.snapshot()
+        assert first["device"] == "dev7"
+        assert first["heartbeat_age_s"] is not None
+        n = len(calls)
+        assert n == 2  # hb + checkpoint, once each
+        for _ in range(10):  # a 10-poll render burst within the TTL
+            job.snapshot()
+        assert len(calls) == n  # memo hit: zero extra stats
     finally:
         svc.close()
 

@@ -40,12 +40,15 @@ import pytest
 from stateright_tpu import chaos
 from stateright_tpu.service import (
     CheckerService,
+    FleetConfig,
+    FleetService,
     Journal,
     JournalTorn,
     ServiceConfig,
     read_journal,
 )
 from stateright_tpu.service.core import _replay_state
+from stateright_tpu.service.fleet import _fleet_replay
 
 #: Pinned full-coverage (generated, unique) counts (bench.py EXPECTED_*).
 PINNED_2PC3 = (1_146, 288)
@@ -183,6 +186,39 @@ def test_replay_state_folds_snapshot_and_events():
     assert state["last_ts"] == 4.0
 
 
+def test_replay_evacuated_carries_the_attempt_charge():
+    """The `evacuated` event journals the killed attempt's wall-clock: a
+    crash between the pool's `evacuated` append and the fleet's
+    `migrated` append must not refund the budget the straggler repair
+    resubmits with (evacuate() charges in memory AND in the event)."""
+    records = []
+
+    def rec(event, **kw):
+        r = {"v": 1, "seq": len(records) + 1, "event": event, **kw}
+        records.append(r)
+        return r
+
+    rec("submitted", ts=1.0, job="job-0001", spec="2pc:3",
+        max_seconds=60.0, dir="s/job-0001")
+    rec("admitted", ts=1.0, job="job-0001", lint_ok=None)
+    rec("started", ts=2.0, job="job-0001", attempt=0, engine="xla", pid=999)
+    rec("evacuated", ts=52.0, job="job-0001", reason="device-0 lost",
+        consumed_s=50.0)
+    state = _replay_state(records)
+    job = state["jobs"]["job-0001"]
+    assert job["status"] == "migrated"
+    assert job["consumed_s"] == 50.0
+    assert job["pid"] is None  # the worker group was killed, no orphan
+    # A `started` journaled AFTER `evacuated` (the spawn/evacuate race's
+    # window) must not resurrect the evacuated job as running — the
+    # sibling pool owns the live copy.
+    rec("started", ts=52.5, job="job-0001", attempt=1, engine="xla",
+        pid=1000)
+    state = _replay_state(records)
+    job = state["jobs"]["job-0001"]
+    assert job["status"] == "migrated" and job["pid"] is None
+
+
 def test_harness_schedule_and_faults_are_seed_deterministic():
     """`tools/service_chaos.py --seed N` is reproducible: the submission
     schedule and the fault plan are pure functions of the seed (the full
@@ -236,6 +272,20 @@ def test_chaos_plan_parse_and_triggers():
     assert 1 <= inj["at"] < 50
     with pytest.raises(ValueError):
         chaos.ChaosPlan("bad clause@@")
+
+
+def test_chaos_install_same_spec_keeps_the_live_plan():
+    """Re-installing the SAME spec is a no-op (fire counters survive):
+    the fleet installs once, then each per-device pool's constructor
+    installs the identical spec — a reset mid-construction would lose
+    counts a pool replay already fired."""
+    plan = chaos.install("seed=1;a.b@n=2")
+    assert plan.fire("a.b") is None  # invocation 1 of 2
+    assert chaos.install("seed=1;a.b@n=2") is plan
+    assert plan.fire("a.b") == {}  # invocation 2 still fires
+    # A DIFFERENT spec replaces the plan; None clears it.
+    assert chaos.install("seed=1;a.b@n=3") is not plan
+    assert chaos.install(None) is None
 
 
 def test_chaos_supervise_wedge_verdict(tmp_path):
@@ -505,6 +555,227 @@ def test_artifact_sweep_reclaims_complete_jobs(tmp_path):
     svc2 = _disarmed(tmp_path)
     assert svc2.job(job.id).status == "done"
     svc2.close()
+
+
+# --- fleet durability (ISSUE 15): routing journal + restart replay ----------
+
+
+def test_fleet_replay_folds_routes_and_migrations():
+    records = []
+
+    def rec(event, **kw):
+        r = {"v": 1, "seq": len(records) + 1, "event": event, **kw}
+        records.append(r)
+        return r
+
+    rec("routed", ts=1.0, job="fjob-0001", spec="2pc:3", device=0,
+        pool_job="job-0001", idempotency_key="k1")
+    rec("routed", ts=1.5, job="fjob-0002", spec="abd:2", device=1,
+        pool_job="job-0001", idempotency_key=None)
+    rec("migrated", ts=2.0, job="fjob-0001", from_device=0, to_device=1,
+        pool_job="job-0002", reason="device-0 lost")
+    state = _fleet_replay(records)
+    assert state["next_id"] == 2
+    assert state["routes"]["fjob-0001"] == {
+        "device": 1, "pool_job": "job-0002", "spec": "2pc:3",
+        "idempotency_key": "k1",
+    }
+    assert state["routes"]["fjob-0002"]["device"] == 1
+    assert state["idem"] == {"k1": "fjob-0001"}
+    assert state["migrations"] == {"fjob-0001": 1}
+    assert state["counters"]["routed"] == 2
+    assert state["counters"]["migrations"] == 1
+    assert state["order"] == ["fjob-0001", "fjob-0002"]
+
+
+def _fleet_disarmed(tmp_path, devices=3):
+    return FleetService(FleetConfig(
+        run_dir=str(tmp_path / "fleet"),
+        devices=devices,
+        monitor_interval_s=0.3,
+        pool=_config(tmp_path, max_inflight=0),
+    ))
+
+
+def test_fleet_restart_replays_routing(tmp_path):
+    """Constructing a fleet over a run dir with journals restores the
+    SAME fleet-job -> (device, pool job) placement: every pool replays
+    its own journal, then fleet.jsonl re-attaches the routing — and
+    idempotent resubmission returns the restored FleetJob."""
+    f1 = _fleet_disarmed(tmp_path)
+    a = f1.submit("2pc:3", idempotency_key="fa")
+    b = f1.submit("2pc:4", idempotency_key="fb")
+    c = f1.submit("abd:2", idempotency_key="fc")
+    routes1 = {j.id: (j.device, j.pool_job.id) for j in f1.jobs()}
+    assert len({d for d, _ in routes1.values()}) == 3  # spread
+    f1.close()
+
+    f2 = _fleet_disarmed(tmp_path)
+    try:
+        routes2 = {j.id: (j.device, j.pool_job.id) for j in f2.jobs()}
+        assert routes1 == routes2
+        assert all(j.recovered for j in f2.jobs())
+        rec = f2.gauges()["journal"]["recovery"]
+        assert rec["torn"] is None
+        assert rec["routes_recovered"] == 3 and rec["attached"] == 3
+        # Pool-side: the jobs requeued through each pool's own journal.
+        assert all(j.pool_job.status == "queued" for j in f2.jobs())
+        # Fleet-scoped idempotency survives the restart.
+        again = f2.submit("2pc:3", idempotency_key="fa")
+        assert again is f2.job(a.id)
+        assert f2.gauges()["idem_dedups"] == 1
+    finally:
+        f2.close()
+
+
+def test_fleet_restart_adopts_pool_jobs_lost_from_torn_fleet_tail(tmp_path):
+    """A torn fleet.jsonl tail loses a routing record, but the POOL
+    journal still owns the job: the restart adopts it back by
+    idempotency key instead of double-running on resubmission."""
+    f1 = _fleet_disarmed(tmp_path)
+    f1.submit("2pc:3", idempotency_key="ta")
+    f1.submit("abd:2", idempotency_key="tb")
+    f1.close()
+    fpath = os.path.join(str(tmp_path / "fleet"), "fleet.jsonl")
+    data = open(fpath, "rb").read()
+    # Amputate the LAST routed record entirely (a boundary-cut torn
+    # tail: the fleet never journaled tb's route, the pool did).
+    cut = data[:-1].rfind(b"\n") + 1
+    with open(fpath, "wb") as fh:
+        fh.write(data[:cut])
+
+    f2 = _fleet_disarmed(tmp_path)
+    try:
+        assert f2.gauges()["journal"]["recovery"]["routes_recovered"] >= 1
+        # tb was adopted from its pool's journal; resubmitting it dedupes
+        # to the adopted job — nothing double-runs.
+        jobs_before = len(f2.jobs())
+        again = f2.submit("abd:2", idempotency_key="tb")
+        assert len(f2.jobs()) == jobs_before
+        assert again.pool_job.idempotency_key == "tb"
+        assert f2.gauges()["idem_dedups"] == 1
+    finally:
+        f2.close()
+
+
+def test_fleet_restart_reroutes_orphans_from_journaled_spec(tmp_path):
+    """A restart that cannot re-attach a routed pool job (the pool's
+    journal is gone) leaves an ORPHAN — the repair pass re-routes it to
+    a healthy sibling from the fleet-journaled spec instead of letting
+    waiters poll forever; with no spec either, it fails typed. The spec
+    survives _recover's compaction, so even a SECOND crash before the
+    repair pass runs stays recoverable."""
+    f1 = _fleet_disarmed(tmp_path, devices=2)
+    a = f1.submit("2pc:3", idempotency_key="oa")
+    victim = a.device
+    f1.close()
+    os.remove(os.path.join(
+        str(tmp_path / "fleet"), f"device-{victim}", "journal.jsonl"
+    ))
+
+    def reopen():  # slow monitor: the repair pass is driven by hand
+        return FleetService(FleetConfig(
+            run_dir=str(tmp_path / "fleet"),
+            devices=2,
+            monitor_interval_s=60.0,
+            pool=_config(tmp_path, max_inflight=0),
+        ))
+
+    f2 = reopen()
+    try:
+        assert f2.job(a.id).pool_job is None
+        assert f2.gauges()["journal"]["recovery"]["orphaned"] == 1
+    finally:
+        # Die again before the repair pass ran (the recovery already
+        # compacted fleet.jsonl — the orphan's spec must have survived).
+        f2.close()
+
+    f3 = reopen()
+    try:
+        fjob = f3.job(a.id)
+        assert fjob.pool_job is None
+        moved = f3._migrate_stragglers()
+        assert moved == 1
+        assert fjob.pool_job is not None and fjob.pool_job.spec == "2pc:3"
+        # The journal-less device is healthy (only its HISTORY died), so
+        # any healthy pool — the victim included — is a valid target.
+        assert fjob.device is not None
+        assert len(fjob.migrations) >= 1
+        assert f3.gauges()["migrations"] >= 1
+        # The unrecoverable shape (no journaled spec at all) settles
+        # typed instead of hanging its waiters.
+        fjob._orphan_spec = None
+        fjob.pool_job = None
+        f3._migrate_stragglers()
+        assert fjob.done and "unrecoverable" in fjob.error
+        assert fjob.wait(timeout=1.0)
+        # An orphan whose journaled spec no longer parses (e.g. a user
+        # family not registered in this incarnation) also fails typed —
+        # a retry would throw identically, and the ValueError must not
+        # kill the monitor sweep and stall every other migration.
+        fjob._rejected = None
+        fjob._orphan_spec = "not-a-registered-spec"
+        f3._migrate_stragglers()
+        assert fjob.done and "migration failed" in fjob.error
+    finally:
+        f3.close()
+
+
+def test_fleet_pools_export_chaos_to_workers(tmp_path):
+    """FleetConfig(chaos=) reaches worker processes like a single pool's
+    does: the spec forwards into every pool config (the _worker_env
+    STPU_CHAOS export keys on it) without resetting the fleet's
+    installed plan."""
+    import types
+
+    spec = "seed=5;checkpoint.torn@n=1"
+    fleet = FleetService(FleetConfig(
+        run_dir=str(tmp_path / "fleet"),
+        devices=2,
+        pool=_config(tmp_path, max_inflight=0),
+        chaos=spec,
+    ))
+    try:
+        live = chaos.plan()
+        assert live is not None and live.spec == spec
+        assert all(p._cfg.chaos == spec for p in fleet.pools)
+        env = fleet.pools[0]._worker_env(
+            types.SimpleNamespace(trace_path="unused"), device=False
+        )
+        assert env["STPU_CHAOS"] == spec
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_restart_replay_converges(chaos_reference):
+    """ISSUE 15 acceptance: SIGKILL the WHOLE 3-device fleet at a seeded
+    point mid-schedule, restart over the same run dir — the fleet journal
+    replays routing, each pool replays its jobs, and every job completes
+    exactly once with counts bit-identical to the undisturbed baseline."""
+    sc, base, schedule, ref = chaos_reference
+    rep = sc.run_scenario(
+        "kill", 42, schedule, os.path.join(base, "fleet3"),
+        reference=ref, max_inflight=2, fleet=3,
+    )
+    assert rep["ok"], rep["problems"]
+    assert rep["restarts"] >= 1 or rep["faults"]["kill_after_s"] > rep["elapsed_s"]
+    assert rep["fleet"]["devices"] == 3
+    assert rep["turnaround_s"]["n"] == 3
+
+
+@pytest.mark.slow
+def test_fleet_device_lost_mid_schedule_converges(chaos_reference):
+    """ISSUE 15 acceptance: a seeded device.lost kills one device's pool
+    mid-schedule; its jobs migrate and the fleet converges exactly-once,
+    bit-identical — with the migration PROVEN in the SLO line."""
+    sc, base, schedule, ref = chaos_reference
+    rep = sc.run_scenario(
+        "device_lost", 42, schedule, os.path.join(base, "fleet_lost"),
+        reference=ref, max_inflight=2, fleet=2,
+    )
+    assert rep["ok"], rep["problems"]
+    assert rep["fleet"]["migrations"] >= 1
 
 
 # --- restart drills (the real service, killed for real) ---------------------

@@ -28,8 +28,11 @@ Verdicts are typed, never a crash:
 Per-check ``skip`` verdicts cover the honest gaps: a platform with no
 archived line yet (e.g. the first chip line), a ``resumed`` fresh line
 (it measures the tail of a space from a checkpoint — not comparable to a
-cold full pass), tri-state ``count_ok``/``lint_ok`` = None, and a missing
-chaos artifact.
+cold full pass), a line whose ``fleet`` provenance records cross-device
+migrations (the box was running a fleet failover sweep concurrently —
+throughput measured amid evacuations judges the chaos harness, not the
+engine), tri-state ``count_ok``/``lint_ok`` = None, and a missing chaos
+artifact.
 
 Inputs: the fresh line defaults to ``runs/bench_detail.json`` (it carries
 everything the primary stdout line does, plus resume/lint provenance) and
@@ -121,6 +124,7 @@ def normalize_fresh(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "count_ok": doc.get("count_ok"),
             "resumed": doc.get("resumed"),
             "lint_ok": doc.get("lint_ok"),
+            "fleet": doc.get("fleet"),
             "full_coverage": doc.get("count_ok") is not None,
             "metric": doc["metric"],
         }
@@ -132,6 +136,7 @@ def normalize_fresh(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "count_ok": doc.get("count_ok"),
             "resumed": resume.get("phase"),
             "lint_ok": doc.get("lint_ok"),
+            "fleet": doc.get("fleet"),
             "full_coverage": doc.get("full_coverage"),
             "metric": f"bench_detail rm={doc.get('rm')}",
         }
@@ -175,6 +180,18 @@ def judge(
                 f"fresh line resumed from a {fresh['resumed']!r} checkpoint "
                 "— it measures the tail of the space, not a cold full "
                 "pass; not comparable",
+            )
+        )
+    elif (fresh.get("fleet") or {}).get("migrations"):
+        fleet = fresh["fleet"]
+        checks.append(
+            _check(
+                "throughput", "skip",
+                f"fleet provenance records {fleet['migrations']} "
+                f"cross-device migration(s) over {fleet.get('devices')} "
+                "device(s) — throughput measured amid failover "
+                "evacuations judges the chaos harness, not the engine; "
+                "not comparable",
             )
         )
     else:
@@ -277,7 +294,8 @@ def judge(
         "verdict": verdict,
         "platform": platform,
         "fresh": {k: fresh.get(k) for k in
-                  ("metric", "value", "count_ok", "resumed", "lint_ok")},
+                  ("metric", "value", "count_ok", "resumed", "lint_ok",
+                   "fleet")},
         "baseline": base,
         "platforms_archived": sorted(trajectory),
         "tolerances": {

@@ -29,7 +29,22 @@ Scenarios (``--scenario``):
 - ``torn``      — like ``die`` but ``journal.torn@n=K``: the crash
   happens MID-append, leaving a torn journal tail the restart must
   recover from (typed, minus the torn record).
-- ``all``       — baseline + kill + torn (the acceptance sweep).
+- ``device_lost`` (fleet runs, ``--fleet N``) — ``device.lost@n=K``
+  kills ONE device's pool mid-schedule: its jobs must migrate and
+  complete exactly once on surviving devices, bit-identical to the
+  undisturbed baseline (ISSUE 15 acceptance).
+- ``all``       — baseline + kill + torn (+ device_lost when --fleet)
+  (the acceptance sweep).
+
+Fleet mode (``--fleet N``): the serve child fronts N per-device pools
+through :class:`FleetService` behind the SAME submit/wait_all surface;
+the SLO line gains a ``fleet`` dict — device count, migrations,
+fleet-level Retry-After accuracy, and p50/p99 turnaround PER DEVICE
+(ROADMAP 3(c')). ``--sessions N`` adds N concurrent interactive Explorer
+sessions (admission-capped through the real ``register_interactive``
+path, polling the real ``ExplorerApp.status()`` handler) alongside the
+batch schedule; their admission verdicts and status-poll latencies land
+in the ``sessions`` dict.
 
 Everything the parent does is jax-free; model work happens in the
 service's worker subprocesses (CPU-pinned via ``ServiceConfig
@@ -58,11 +73,13 @@ same entry points.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -120,6 +137,13 @@ def fault_plan(seed: int, scenario: str) -> Dict[str, Any]:
         return {"die_at_record": rng.randint(3, 10)}
     if scenario == "torn":
         return {"torn_at_record": rng.randint(3, 10)}
+    if scenario == "device_lost":
+        # Which routing decision arms the loss, and how long after it
+        # the device dies (mid-job for any spec in the pool).
+        return {
+            "lost_at_route": rng.randint(1, 2),
+            "lost_after_s": round(rng.uniform(1.0, 4.0), 3),
+        }
     return {}
 
 
@@ -131,8 +155,15 @@ def fault_plan(seed: int, scenario: str) -> Dict[str, Any]:
 def serve(args: argparse.Namespace) -> int:
     """One service incarnation: recover (if the run dir has a journal),
     resubmit the whole schedule idempotently, wait for every job, write
-    driver_results.json. Killable at any instant — that is the point."""
-    from stateright_tpu.service import CheckerService, ServiceConfig
+    driver_results.json. Killable at any instant — that is the point.
+    With ``--fleet N`` the incarnation fronts N per-device pools through
+    FleetService behind the same surface."""
+    from stateright_tpu.service import (
+        CheckerService,
+        FleetConfig,
+        FleetService,
+        ServiceConfig,
+    )
 
     with open(args.schedule) as fh:
         schedule = json.load(fh)
@@ -156,8 +187,26 @@ def serve(args: argparse.Namespace) -> int:
         admission_lint=False,
         chaos=args.chaos or None,
     )
-    svc = CheckerService(cfg)
+    if args.fleet:
+        svc = FleetService(FleetConfig(
+            run_dir=args.run_dir,
+            devices=args.fleet,
+            monitor_interval_s=0.3,
+            journal_keep=12,
+            chaos=args.chaos or None,
+            # The pool template: per-device run dirs/devices/halt mode
+            # are overwritten per pool; the chaos plan installs ONCE at
+            # the fleet level.
+            pool=dataclasses.replace(cfg, chaos=None),
+        ))
+    else:
+        svc = CheckerService(cfg)
     svc.log = log
+    sessions = (
+        _session_swarm(svc, args.sessions, args.run_dir)
+        if args.sessions
+        else None
+    )
     stats_path = os.path.join(args.run_dir, "admission_stats.jsonl")
     t0 = time.monotonic()
     jobs = []
@@ -192,8 +241,14 @@ def serve(args: argparse.Namespace) -> int:
     )
     if not svc.wait_all(timeout=args.wait_s):
         log(f"wait_all timed out after {args.wait_s}s: {svc.gauges()}")
+        if sessions is not None:
+            # Stop the swarm BEFORE teardown: its threads must not race
+            # a closing service, and the aggregate stats row flushes so
+            # the timed-out incarnation still reports its sessions SLO.
+            sessions.stop()
         svc.close()
         return 4
+    session_stats = sessions.stop() if sessions is not None else None
     out = {
         "jobs": {
             entry["idem"]: {
@@ -213,6 +268,7 @@ def serve(args: argparse.Namespace) -> int:
         },
         "gauges": svc.gauges(),
         "retry_after": retry_stats,
+        "sessions": session_stats,
     }
     svc.close()
     tmp = os.path.join(args.run_dir, "driver_results.json.tmp")
@@ -220,6 +276,136 @@ def serve(args: argparse.Namespace) -> int:
         json.dump(out, fh, indent=1)
     os.replace(tmp, os.path.join(args.run_dir, "driver_results.json"))
     return 0
+
+
+class _SessionChecker:
+    """A jax-free stand-in for an interactive checker: just enough
+    surface for ``register_interactive`` + ``ExplorerApp.status()`` —
+    the load swarm measures the SERVICE's admission/status path, not an
+    engine (the serve child must stay jax-free and killable in <1s)."""
+
+    class _Model:
+        def properties(self):
+            return []
+
+    def model(self):
+        return self._Model()
+
+    def is_done(self):
+        return False
+
+    def state_count(self):
+        return 0
+
+    def unique_state_count(self):
+        return 0
+
+    def max_depth(self):
+        return 0
+
+    def discoveries(self):
+        return {}
+
+    def metrics(self):
+        return {"engine": "session", "job_id": getattr(self, "job_id", None)}
+
+    def attach_job(self, job_id):
+        self.job_id = job_id
+
+
+class _SessionSwarm:
+    """N concurrent interactive sessions (ROADMAP 3(c')): each thread
+    registers through the real admission path (``AdmissionError`` past
+    the cap counts as a rejection, retried after a backoff) and polls
+    the real ``ExplorerApp.status()`` handler until stopped. Stats are
+    appended live to ``session_stats.jsonl`` so a SIGKILL loses
+    nothing."""
+
+    def __init__(self, svc, n: int, run_dir: str):
+        self._svc = svc
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.admitted = 0
+        self.rejected = 0
+        self.polls = 0
+        self.poll_ms: List[float] = []
+        self._path = os.path.join(run_dir, "session_stats.jsonl")
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self, i: int) -> None:
+        from stateright_tpu.checker.explorer import ExplorerApp
+        from stateright_tpu.service import AdmissionError
+
+        while not self._stop.is_set():
+            checker = _SessionChecker()
+            try:
+                job = self._svc.register_interactive(
+                    checker, label=f"session-{i}"
+                )
+            except AdmissionError:
+                with self._lock:
+                    self.rejected += 1
+                self._stop.wait(0.5)
+                continue
+            except RuntimeError:
+                return  # service closed
+            with self._lock:
+                self.admitted += 1
+            app = ExplorerApp(checker, service=self._svc, job=job)
+            try:
+                # Poll /.status (the handler itself, no socket) for a
+                # while, then release the slot so capped siblings admit.
+                for _ in range(20):
+                    if self._stop.is_set():
+                        break
+                    t = time.monotonic()
+                    app.status()
+                    with self._lock:
+                        self.polls += 1
+                        self.poll_ms.append(
+                            round((time.monotonic() - t) * 1e3, 3)
+                        )
+                    self._stop.wait(0.1)
+            finally:
+                app.close()
+                # Live append: each session lifecycle flushes the
+                # running aggregate, so a SIGKILLed incarnation's last
+                # row still carries (nearly) everything it measured.
+                self._append(self._row())
+
+    def _row(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "sessions": len(self._threads),
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "status_polls": self.polls,
+                "status_poll_ms": _percentiles(list(self.poll_ms)),
+            }
+
+    def _append(self, row: Dict[str, Any]) -> None:
+        try:
+            with open(self._path, "a") as fh:
+                fh.write(json.dumps(row) + "\n")
+        except OSError:
+            pass
+
+    def stop(self) -> Dict[str, Any]:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        stats = self._row()
+        self._append(stats)
+        return stats
+
+
+def _session_swarm(svc, n: int, run_dir: str) -> _SessionSwarm:
+    return _SessionSwarm(svc, n, run_dir)
 
 
 def _overload_probe(svc, schedule) -> Dict[str, Any]:
@@ -231,7 +417,12 @@ def _overload_probe(svc, schedule) -> Dict[str, Any]:
     spec = schedule["jobs"][0]["spec"]
     observed = accurate = 0
     hints: List[float] = []
-    for i in range(svc._cfg.max_queue + 2):
+    # Queue capacity: the pool cap, or (fleet) the per-device cap summed
+    # — the burst must out-size whatever can absorb it.
+    cap = getattr(svc._cfg, "max_queue", None)
+    if cap is None:
+        cap = sum(p._cfg.max_queue for p in svc.pools)
+    for i in range(cap + 2):
         try:
             svc.submit(spec, max_seconds=schedule["jobs"][0]["max_seconds"])
         except AdmissionError as e:
@@ -265,6 +456,8 @@ def run_incarnation(
     max_inflight: int = 2,
     overload: bool = False,
     wait_s: float = 300.0,
+    fleet: int = 0,
+    sessions: int = 0,
 ) -> int:
     """Spawn one ``--serve`` child (its own process group) and either let
     it finish or SIGKILL the whole group after ``kill_after_s`` — the
@@ -275,6 +468,10 @@ def run_incarnation(
         "--max-inflight", str(max_inflight),
         "--wait-s", str(wait_s),
     ]
+    if fleet:
+        argv += ["--fleet", str(fleet)]
+    if sessions:
+        argv += ["--sessions", str(sessions)]
     if chaos:
         argv += ["--chaos", chaos]
     if overload:
@@ -305,13 +502,9 @@ def run_incarnation(
     return -9
 
 
-def journal_history(run_dir: str) -> List[Dict[str, Any]]:
-    """Every journal record across the compaction rotations, oldest
-    first — each event appears exactly once (compaction rewrites the
-    live log as a snapshot; rotations keep the raw history)."""
+def _rotation_chain(base: str) -> List[Dict[str, Any]]:
     from stateright_tpu.service import read_journal
 
-    base = os.path.join(run_dir, "journal.jsonl")
     paths = []
     i = 1
     while os.path.exists(f"{base}.{i}"):
@@ -324,6 +517,37 @@ def journal_history(run_dir: str) -> List[Dict[str, Any]]:
     for p in paths:
         records.extend(read_journal(p).records)
     return records
+
+
+def journal_history(run_dir: str) -> List[Dict[str, Any]]:
+    """Every POOL journal record across the compaction rotations, oldest
+    first — each event appears exactly once (compaction rewrites the
+    live log as a snapshot; rotations keep the raw history). Fleet runs
+    concatenate every device's journal, each record tagged ``_device``
+    (pool job ids collide across devices — "job-0001" exists on each)."""
+    single = _rotation_chain(os.path.join(run_dir, "journal.jsonl"))
+    if single:
+        return single
+    records: List[Dict[str, Any]] = []
+    for device in sorted(
+        d for d in os.listdir(run_dir) if d.startswith("device-")
+    ) if os.path.isdir(run_dir) else []:
+        for rec in _rotation_chain(
+            os.path.join(run_dir, device, "journal.jsonl")
+        ):
+            rec = dict(rec, _device=device)
+            records.append(rec)
+    return records
+
+
+def fleet_journal(run_dir: str) -> List[Dict[str, Any]]:
+    """The fleet's own routing journal (``fleet.jsonl`` rotations),
+    oldest first; empty for single-pool runs."""
+    return _rotation_chain(os.path.join(run_dir, "fleet.jsonl"))
+
+
+def _is_fleet(run_dir: str) -> bool:
+    return os.path.exists(os.path.join(run_dir, "fleet.jsonl"))
 
 
 def event_signature(records: List[Dict[str, Any]]) -> List[str]:
@@ -341,18 +565,46 @@ def check_invariant(
 ) -> Dict[str, Any]:
     """The acceptance invariant: every scheduled job present, done,
     completed exactly once across the whole journal history, counts
-    bit-identical to the reference (per spec)."""
+    bit-identical to the reference (per spec). Fleet runs key done
+    events by (device, pool job) and resolve each fleet job's pool-job
+    HISTORY through the routing journal — a migrated job must complete
+    exactly once across ALL the devices it touched."""
     with open(os.path.join(run_dir, "driver_results.json")) as fh:
         results = json.load(fh)["jobs"]
     problems: List[str] = []
     history = journal_history(run_dir)
+    fleet = _is_fleet(run_dir)
     done_events: Dict[str, int] = {}
+
+    def key_of(rec):
+        return (
+            f"{rec['_device']}:{rec['job']}" if fleet else rec["job"]
+        )
+
     for r in history:
         if r["event"] == "completed" and r.get("status") == "done":
-            done_events[r["job"]] = done_events.get(r["job"], 0) + 1
+            done_events[key_of(r)] = done_events.get(key_of(r), 0) + 1
     for jid, n in done_events.items():
         if n > 1:
             problems.append(f"{jid} completed done {n} times")
+    # Fleet: fleet job id -> every (device, pool_job) it was ever routed
+    # to (exactly one of them must have completed it).
+    routes: Dict[str, List[str]] = {}
+    if fleet:
+        for r in fleet_journal(run_dir):
+            if r["event"] == "routed":
+                routes.setdefault(r["job"], []).append(
+                    f"device-{r['device']}:{r['pool_job']}"
+                )
+            elif r["event"] == "migrated":
+                routes.setdefault(r["job"], []).append(
+                    f"device-{r['to_device']}:{r['pool_job']}"
+                )
+            elif r["event"] == "snapshot":
+                for fid, route in r["state"].get("routes", {}).items():
+                    routes.setdefault(fid, []).append(
+                        f"device-{route['device']}:{route['pool_job']}"
+                    )
     for entry in schedule["jobs"]:
         got = results.get(entry["idem"])
         if got is None:
@@ -363,10 +615,17 @@ def check_invariant(
                 f"{entry['idem']} status={got['status']} ({got['error']})"
             )
             continue
-        if done_events.get(got["id"], 0) != 1:
+        if fleet:
+            dones = sum(
+                done_events.get(k, 0)
+                for k in dict.fromkeys(routes.get(got["id"], []))
+            )
+        else:
+            dones = done_events.get(got["id"], 0)
+        if dones != 1:
             problems.append(
                 f"{entry['idem']} ({got['id']}) has "
-                f"{done_events.get(got['id'], 0)} done events in the journal"
+                f"{dones} done events in the journal"
             )
         if reference is not None:
             want = reference[entry["spec"]]
@@ -402,7 +661,10 @@ def _percentiles(values: List[float]) -> Optional[Dict[str, float]]:
 
 def slo_stats(run_dir: str) -> Dict[str, Any]:
     """Admission latency (appended live by every incarnation, so kills
-    lose nothing) + per-job turnaround from the journal history."""
+    lose nothing) + per-job turnaround from the journal history. Fleet
+    runs additionally report the ``fleet`` dict: device count,
+    migrations/losses from the routing journal, per-DEVICE turnaround
+    percentiles (ROADMAP 3(c')), and the session-swarm stats."""
     latencies: List[float] = []
     stats_path = os.path.join(run_dir, "admission_stats.jsonl")
     if os.path.exists(stats_path):
@@ -412,14 +674,25 @@ def slo_stats(run_dir: str) -> Dict[str, Any]:
                     latencies.append(json.loads(line)["latency_ms"])
                 except (json.JSONDecodeError, KeyError):
                     pass
+    fleet = _is_fleet(run_dir)
     submitted: Dict[str, float] = {}
     completed: Dict[str, float] = {}
+    per_device: Dict[str, List[float]] = {}
     recovery = None
     for r in journal_history(run_dir):
+        jid = r.get("job")
+        key = f"{r['_device']}:{jid}" if fleet else jid
         if r["event"] == "submitted":
-            submitted.setdefault(r["job"], r["ts"])
+            submitted.setdefault(key, r["ts"])
         elif r["event"] == "completed" and r.get("status") == "done":
-            completed[r["job"]] = r["ts"]
+            completed[key] = r["ts"]
+            # Same filter as the aggregate below: a job whose submitted
+            # record rotated out of the keep-K chain must be skipped,
+            # not counted as a spurious 0.0s turnaround.
+            if fleet and key in submitted:
+                per_device.setdefault(r["_device"], []).append(
+                    r["ts"] - submitted[key]
+                )
         elif r["event"] == "recovered":
             recovery = {
                 k: r.get(k)
@@ -431,11 +704,38 @@ def slo_stats(run_dir: str) -> Dict[str, Any]:
     turnaround = [
         completed[j] - submitted[j] for j in completed if j in submitted
     ]
-    return {
+    out = {
         "admission_latency_ms": _percentiles(latencies),
         "turnaround_s": _percentiles(turnaround),
         "journal": recovery,
     }
+    if fleet:
+        froutes = fleet_journal(run_dir)
+        devices = {
+            d for d in os.listdir(run_dir)
+            if d.startswith("device-")
+            and os.path.isdir(os.path.join(run_dir, d))
+        }
+        sessions = None
+        spath = os.path.join(run_dir, "session_stats.jsonl")
+        if os.path.exists(spath):
+            with open(spath) as fh:
+                rows = [json.loads(l) for l in fh if l.strip()]
+            if rows:
+                sessions = rows[-1]
+        out["fleet"] = {
+            "devices": len(devices),
+            "migrations": sum(
+                1 for r in froutes if r["event"] == "migrated"
+            ),
+            "routed": sum(1 for r in froutes if r["event"] == "routed"),
+            # Per-device p50/p99 turnaround: the ROADMAP 3(c') SLO split.
+            "per_device": {
+                d: _percentiles(v) for d, v in sorted(per_device.items())
+            },
+            "sessions": sessions,
+        }
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -454,6 +754,8 @@ def run_scenario(
     max_restarts: int = 4,
     overload: bool = False,
     wait_s: float = 300.0,
+    fleet: int = 0,
+    sessions: int = 0,
 ) -> Dict[str, Any]:
     """One scenario end to end; returns its report (and, for baseline,
     the reference counts the others compare against)."""
@@ -465,9 +767,24 @@ def run_scenario(
     faults = fault_plan(seed, name)
     t0 = time.monotonic()
     restarts = 0
-    kw = dict(max_inflight=max_inflight, overload=overload, wait_s=wait_s)
+    kw = dict(max_inflight=max_inflight, overload=overload, wait_s=wait_s,
+              fleet=fleet, sessions=sessions)
     if name == "baseline":
         rc = run_incarnation(run_dir, schedule_path, **kw)
+    elif name == "device_lost":
+        if not fleet:
+            raise ValueError("device_lost needs --fleet N")
+        rc = run_incarnation(
+            run_dir, schedule_path,
+            chaos=(
+                f"seed={seed};device.lost@n={faults['lost_at_route']}"
+                f":after_s={faults['lost_after_s']}"
+            ),
+            **kw,
+        )
+        while rc != 0 and restarts < max_restarts:
+            restarts += 1
+            rc = run_incarnation(run_dir, schedule_path, **kw)
     elif name == "kill":
         rc = run_incarnation(
             run_dir, schedule_path,
@@ -505,6 +822,15 @@ def run_scenario(
         "elapsed_s": round(time.monotonic() - t0, 3),
         **slo_stats(run_dir),
     }
+    if name == "device_lost":
+        # The migration must actually have happened — a device_lost pass
+        # that never killed a device proves nothing.
+        migrations = (report.get("fleet") or {}).get("migrations", 0)
+        if not migrations:
+            report["ok"] = False
+            report["problems"] = report["problems"] + [
+                "device_lost scenario recorded no migrations"
+            ]
     if overload:
         with open(os.path.join(run_dir, "driver_results.json")) as fh:
             report["retry_after"] = json.load(fh).get("retry_after")
@@ -560,7 +886,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--jobs", type=int, default=3)
     p.add_argument("--scenario", default="all",
-                   choices=("all", "baseline", "kill", "die", "torn"))
+                   choices=("all", "baseline", "kill", "die", "torn",
+                            "device_lost"))
+    p.add_argument("--fleet", type=int, default=0,
+                   help="front N per-device pools (FleetService); 0 = "
+                        "the single-pool service")
+    p.add_argument("--sessions", type=int, default=0,
+                   help="concurrent interactive Explorer sessions "
+                        "polling /.status alongside the batch schedule")
     p.add_argument("--base-dir", default=None,
                    help="scenario run dirs land here "
                         "(default runs/service_chaos/seed<N>)")
@@ -591,6 +924,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "tool": "service_chaos",
         "seed": args.seed,
         "jobs": args.jobs,
+        "fleet_devices": args.fleet or None,
+        "sessions": args.sessions or None,
         "specs": [j["spec"] for j in schedule["jobs"]],
         "scenarios": {},
         "ok": True,
@@ -602,6 +937,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         names = (
             ["baseline", "kill", "torn"]
+            + (["device_lost"] if args.fleet else [])
             if args.scenario == "all"
             else ["baseline"]
             + ([args.scenario] if args.scenario != "baseline" else [])
@@ -611,6 +947,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_inflight=args.max_inflight,
             max_restarts=args.max_restarts,
             wait_s=args.wait_s,
+            fleet=args.fleet,
+            sessions=args.sessions,
         )
         for name in names:
             rep = run_scenario(

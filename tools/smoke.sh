@@ -14,7 +14,11 @@
 # and converges to exact counts — the durability tier's tier-0 proof),
 # and the <30s TELEMETRY drill (one packed model with the metrics
 # recorder on, /.metrics scraped from a make_app instance and validated
-# with the OpenMetrics test parser, counters cross-checked exactly).
+# with the OpenMetrics test parser, counters cross-checked exactly),
+# and the <30s FLEET FAILOVER drill (a 2-device FleetService;
+# device.lost kills one device's pool mid-job, the victim migrates to
+# the survivor and completes bit-identical — the fleet tier's tier-0
+# proof).
 # A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
@@ -35,12 +39,13 @@ timeout -k 5 60 python tools/stpu_lint.py --json-out runs/lint.json
 # no jax, <5 s.
 timeout -k 5 60 python tools/bench_regress.py --self-test
 
-exec timeout -k 10 420 python -m pytest \
+exec timeout -k 10 480 python -m pytest \
   tests/test_obs.py \
   tests/test_promexport.py::test_smoke_metrics_endpoint \
   tests/test_fused_dispatch.py::test_fused_matches_single_full_coverage \
   tests/test_packed_increment.py \
   tests/test_supervise.py::test_smoke_kill_resume \
   tests/test_service.py::test_smoke_service_kill_resume \
+  tests/test_service.py::test_smoke_fleet_failover \
   tests/test_service_durability.py::test_smoke_service_restart_resume \
   -x -q -p no:cacheprovider "$@"

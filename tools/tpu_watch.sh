@@ -82,11 +82,18 @@ fi
 # against the archived runs/archive/BENCH_r*.json trajectory + the chaos
 # SLO line — schedule it right after a bench stage so the window
 # self-judges (typed verdict JSON to runs/regress.json; no device).
+# A bare "fleet_chaos" expands to the FLEET chaos sweep (ISSUE 15):
+# a seeded 2-device FleetService schedule with interactive sessions,
+# full-fleet SIGKILL-restart, torn journal, AND a device.lost kill —
+# exactly-once + bit-identical across migrations, per-device SLOs in
+# runs/service_chaos.json's "fleet" dicts.
 for i in "${!STAGES[@]}"; do
   if [ "${STAGES[$i]}" = "soak_resume" ]; then
     STAGES[$i]="soak_resume,14400,runs/soak_resume.log,python tools/soak.py --config rm10 --audit"
   elif [ "${STAGES[$i]}" = "service_chaos" ]; then
     STAGES[$i]="service_chaos,1800,runs/service_chaos.log,python tools/service_chaos.py --seed 42 --jobs 3"
+  elif [ "${STAGES[$i]}" = "fleet_chaos" ]; then
+    STAGES[$i]="fleet_chaos,2400,runs/fleet_chaos.log,python tools/service_chaos.py --seed 42 --jobs 4 --fleet 2 --sessions 4"
   elif [ "${STAGES[$i]}" = "bench_regress" ]; then
     # Outfile is a LOG, not runs/regress.json: the stage runner's stdout
     # redirect truncates its outfile at start, which would destroy the
