@@ -36,7 +36,10 @@ diagnostics and per-pass progress go to stderr and
 
 Per-level timing detail is written to ``runs/bench_detail.json`` (levels,
 frontier widths, per-level seconds, compile vs steady split) for the
-BASELINE.md breakdown. With ``STPU_TRACE`` set the workers additionally
+BASELINE.md breakdown. ``BENCH_MUX=K`` adds the batched-scheduling
+throughput probe (K same-spec jobs multiplexed through one
+CheckerService; jobs_per_sec + dispatches_per_job in the detail's
+``mux`` dict — knobs ``BENCH_MUX_SPEC``, ``BENCH_MUX_BUDGET_S``). With ``STPU_TRACE`` set the workers additionally
 emit the span JSONL (``tools/roofline.py --measured`` consumes it); the
 trace and heartbeat paths are recorded in ``runs/bench_detail.json``.
 """
@@ -436,6 +439,66 @@ def _run_matrix(platform: str) -> list:
     return rows
 
 
+def _run_mux_throughput(platform: str) -> dict:
+    """``BENCH_MUX=K``: the batched-scheduling throughput probe
+    (docs/service.md "Batched scheduling"). K same-spec small jobs
+    (``BENCH_MUX_SPEC``, default 2pc:3) through ONE CheckerService with
+    ``mux_k=K`` — the scheduler folds them into one ``worker.py --mux``
+    group, so the whole batch pays one program's dispatch sequence.
+    Reports jobs_per_sec and dispatches_per_job; the exactness and the
+    >= 3x dispatch-saving acceptance live in tests/test_mux.py — this
+    row is the trend line bench_regress watches."""
+    import shutil
+
+    from stateright_tpu.service.core import CheckerService, ServiceConfig
+
+    k = int(os.environ.get("BENCH_MUX", "0") or 0)
+    spec = os.environ.get("BENCH_MUX_SPEC", "2pc:3")
+    budget = float(os.environ.get("BENCH_MUX_BUDGET_S", "420"))
+    run_dir = os.path.join(RUNS, "bench_mux")
+    shutil.rmtree(run_dir, ignore_errors=True)
+    svc = CheckerService(ServiceConfig(
+        run_dir=run_dir,
+        platform="cpu" if platform == "cpu" else "default",
+        mux_k=k,
+        # One group wants all K members startable at once.
+        max_inflight=k,
+        max_queue=2 * k,
+        default_max_seconds=budget,
+        admission_lint=False,  # shipped spec; the lint gate has its own pins
+        probe_auto=False,
+    ))
+    try:
+        t0 = time.monotonic()
+        jobs = [svc.submit(spec, max_seconds=budget) for _ in range(k)]
+        svc.wait_all(timeout=budget * 1.5)
+        elapsed = time.monotonic() - t0
+        done = [j for j in jobs if j.status == "done"]
+        lane_metrics = [j.result.get("metrics", {}) for j in done]
+        dispatches = max(
+            (m.get("dispatches", 0) for m in lane_metrics), default=0
+        )
+        gauges = svc.gauges()
+        return {
+            "spec": spec,
+            "k": k,
+            "jobs_done": len(done),
+            "jobs_failed": len(jobs) - len(done),
+            "seconds": round(elapsed, 3),
+            "jobs_per_sec": round(len(done) / max(elapsed, 1e-9), 3),
+            "dispatches": dispatches,
+            "dispatches_per_job": round(dispatches / max(len(done), 1), 2),
+            "dispatches_saved": max(
+                (m.get("mux_dispatches_saved", 0) for m in lane_metrics),
+                default=0,
+            ),
+            "mux_groups": gauges.get("mux_groups", 0),
+            "mux_lanes": gauges.get("mux_lanes", 0),
+        }
+    finally:
+        svc.close()
+
+
 def _worker(platform: str) -> None:
     """Child-process body: the actual measurement, on ``platform``. Writes
     bench_detail.json and prints the final JSON line on stdout. The parent
@@ -702,6 +765,8 @@ def _worker(platform: str) -> None:
         else None
     )
 
+    mux_info = None
+
     def write_detail(matrix):
         os.makedirs(RUNS, exist_ok=True)
         with open(os.path.join(RUNS, "bench_detail.json"), "w") as fh:
@@ -777,6 +842,11 @@ def _worker(platform: str) -> None:
                     "states_per_sec": round(value, 1),
                     "count_ok": count_ok,
                     "audit": audit,
+                    # Batched-scheduling throughput (BENCH_MUX=K;
+                    # docs/service.md "Batched scheduling"): jobs/sec and
+                    # dispatches/job for K same-spec jobs multiplexed
+                    # through one service. None unless the knob is set.
+                    "mux": mux_info,
                     "levels": detail,
                     "matrix": matrix,
                 },
@@ -794,6 +864,13 @@ def _worker(platform: str) -> None:
         except Exception as e:  # the primary metric line must survive
             _log(f"matrix runner FAILED: {type(e).__name__}: {e}")
             matrix = [{"error": f"{type(e).__name__}: {e}"}]
+    if int(os.environ.get("BENCH_MUX", "0") or 0) > 1:
+        try:
+            mux_info = _run_mux_throughput(platform)
+            _log(f"mux throughput: {mux_info}")
+        except Exception as e:  # same contract as the matrix
+            _log(f"mux throughput FAILED: {type(e).__name__}: {e}")
+            mux_info = {"error": f"{type(e).__name__}: {e}"}
     write_detail(matrix)
 
 

@@ -54,6 +54,7 @@ def plan_for(
     *,
     frontier_capacity: Optional[int] = None,
     table_capacity: Optional[int] = None,
+    mux_k: Optional[int] = None,
     _resolved=None,
 ) -> Dict[str, Any]:
     """The compile plan one spec commits to on one platform, at the
@@ -62,7 +63,18 @@ def plan_for(
     excluded: the census prices the DECLARED plan, which is also exactly
     the shape set ``tools/warm_cache.py`` can pre-compile.
     ``_resolved`` lets :func:`build_census` resolve each spec's model
-    once instead of once per platform."""
+    once instead of once per platform.
+
+    ``mux_k`` adds the multiplexed-superstep shape classes a service
+    running with ``STPU_MUX=K`` would additionally compile
+    (``xla_mux.py``; docs/service.md "Batched scheduling"): one batched
+    program per bucket at lane count K — the mux engine has no in-program
+    cand ladder, so its shape class is exactly ``(k, bucket, cand_cap)``.
+    Only mux-eligible plans get the sub-dict (family in
+    ``registry.MUX_FAMILIES``, non-delta dedup); when present, the mux
+    programs count toward the same STPU007 budget — batching is opt-in,
+    so the default census (and the banked ``runs/compile_plan.json``)
+    stays the solo plan."""
     if _resolved is None:
         from ..service.registry import resolve
 
@@ -90,7 +102,7 @@ def plan_for(
                 "rungs": [list(r) for r in cand_rungs(bucket, cap_of, k)],
             }
         )
-    return {
+    plan = {
         "spec": spec,
         "platform": platform,
         "state_words": W,
@@ -103,9 +115,24 @@ def plan_for(
         "distinct_programs": len(shapes),
         "budget": int(getattr(model, "xla_compile_budget", MAX_COMPILE_SHAPES)),
     }
+    if mux_k is not None and mux_k > 1:
+        from ..service.registry import MUX_FAMILIES, parse
+
+        if parse(spec)[0] in MUX_FAMILIES and dedup != "delta":
+            plan["mux"] = {
+                "k": mux_k,
+                "shapes": [
+                    {"bucket": b, "cand_cap": cap_of(b)}
+                    for b in ladder_buckets(f_cap)
+                ],
+            }
+            plan["mux"]["distinct_programs"] = len(plan["mux"]["shapes"])
+    return plan
 
 
-def build_census(specs: Optional[List[str]] = None) -> Dict[str, Any]:
+def build_census(
+    specs: Optional[List[str]] = None, mux_k: Optional[int] = None
+) -> Dict[str, Any]:
     """The full census: every shipped spec's plan on both platforms.
     Callers that may touch a fresh jax process (``tools/warm_cache.py``'s
     parent) must ``surfaces.pin_cpu()`` first — model resolution builds
@@ -117,7 +144,8 @@ def build_census(specs: Optional[List[str]] = None) -> Dict[str, Any]:
     for spec in specs if specs is not None else list(SHIPPED):
         resolved = resolve(spec)
         out["specs"][spec] = {
-            p: plan_for(spec, p, _resolved=resolved) for p in PLATFORMS
+            p: plan_for(spec, p, mux_k=mux_k, _resolved=resolved)
+            for p in PLATFORMS
         }
     return out
 
@@ -128,7 +156,13 @@ def census_findings(census: Dict[str, Any]) -> List[Finding]:
     findings: List[Finding] = []
     for spec, plans in census["specs"].items():
         for platform, plan in plans.items():
-            n, budget = plan["distinct_programs"], plan["budget"]
+            # A mux-enabled census prices the TOTAL a batching service
+            # compiles: the solo plan plus one batched program per
+            # bucket at lane count K.
+            n = plan["distinct_programs"] + plan.get("mux", {}).get(
+                "distinct_programs", 0
+            )
+            budget = plan["budget"]
             if n <= budget:
                 continue
             buckets = [s["bucket"] for s in plan["shapes"]]

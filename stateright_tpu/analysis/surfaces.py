@@ -3,8 +3,8 @@
 A *surface* is one traceable device program the repo ships: a packed
 model's vmapped transition/property kernels, an engine superstep at a
 concrete dedup x compaction configuration, a fused multi-level dispatch,
-one of the standalone ops programs (deltaset ``maintain``, hashset
-``insert``), or a Pallas kernel. Each surface traces to a ``ClosedJaxpr``
+the multiplexed (K-lane-batched) superstep, one of the standalone ops
+programs (deltaset ``maintain``, hashset ``insert``), or a Pallas kernel. Each surface traces to a ``ClosedJaxpr``
 on the CPU backend — no device, no execution, no XLA compile — and
 declares which rule scans apply:
 
@@ -90,6 +90,13 @@ SUPPORTED_PALLAS_BLOCKS = (256, 512, 1024)
 #: The virtual CPU mesh width the sharded-engine surface traces under —
 #: the same 8-device mesh tests/conftest.py forces for the mesh tests.
 MESH_DEVICES = 8
+
+#: The lane counts the multiplexed-superstep surfaces trace at
+#: (xla_mux.py; docs/service.md "Batched scheduling"): the smallest real
+#: batch and a mid-size one. The jaxpr is structurally K-independent —
+#: like KERNEL_BATCH, two points keep the pin honest without paying a
+#: trace per possible K.
+MUX_KS = (2, 4)
 
 
 class SurfaceSkip(Exception):
@@ -525,6 +532,99 @@ def _sharded_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
     return [make("hash"), make("sorted")]
 
 
+def _mux_batched_args(checker, model, k: int):
+    """The superstep's argument shapes under a leading ``k`` lane axis —
+    exactly what ``MuxChecker._build_mux_fused``'s ``vmap`` of the
+    single-level superstep carries (the table pytree batches leaf-wise)."""
+    import jax
+
+    return tuple(
+        jax.tree_util.tree_map(lambda a: _sds((k,) + a.shape, a.dtype), arg)
+        for arg in _superstep_args(checker, model, F_CAP)
+    )
+
+
+def _mux_surfaces() -> List[Tuple[str, Callable[[], List[Finding]]]]:
+    """The multiplexed superstep (xla_mux.py): ``jax.vmap`` of the
+    engine's single-level superstep under a leading K lane axis — the
+    program ``worker.py --mux`` compiles. Three pins per the surface
+    taxonomy above:
+
+    - ``kernel:…:mux-packed_step:k{K}`` — STPU001/STPU002 on the
+      DOUBLY-vmapped model kernel (vmap-over-lanes of the vmap-over-rows
+      transition), the new vmap nesting mux introduces. The batched
+      superstep itself legitimately contains engine-level scatters, the
+      same exemption the solo engine surfaces get;
+    - ``engine:…:mux-superstep:k{K}:{dedup}`` — the engine rules
+      (STPU003 sort widths now carry the K batch dimension, STPU005
+      statics) over the batched superstep, both mux-supported dedups
+      (delta is ``MuxError``-ineligible, so no surface exists to lint);
+    - ``lower:…:mux-superstep:k2`` — one STPU008 cross-backend lowering
+      diff of the whole batched program (cheap: ~0.6 s both platforms).
+    """
+    out: List[Tuple[str, Callable[[], List[Finding]]]] = []
+    spec = "2pc:3"
+
+    def make_kernel(k: int):
+        name = f"kernel:{spec}:mux-packed_step:k{k}"
+
+        def run():
+            jax, jnp = _jnp()
+            from ..service.registry import resolve
+
+            model, _ = resolve(spec)
+            rows = _sds((k, KERNEL_BATCH, model.state_words), jnp.uint32)
+            jx = _trace(jax.vmap(jax.vmap(model.packed_step)), rows)
+            return (
+                taint_scatters(jx, name)
+                + output_transposes(jx, name)
+                + wide_sorts(jx, name)
+            )
+
+        return name, run
+
+    def make_engine(k: int, dedup: str):
+        name = f"engine:{spec}:mux-superstep:k{k}:{dedup}"
+
+        def run():
+            jax, _ = _jnp()
+            model, checker = _spawn(spec, dedup)
+            step = checker._build_superstep(F_CAP, CAND_CAP)
+            jx = _trace(jax.vmap(step), *_mux_batched_args(checker, model, k))
+            return (
+                wide_sorts(jx, name)
+                + cond_flush_sorts(jx, name, _flush_lanes(checker))
+                + mosaic_kernel_rules(jx, name)
+            )
+
+        return name, run
+
+    def make_lowering(k: int):
+        name = f"lower:{spec}:mux-superstep:k{k}"
+
+        def run():
+            jax, _ = _jnp()
+            model, checker = _spawn(spec, "sorted")
+            step = checker._build_superstep(F_CAP, CAND_CAP)
+            args = _mux_batched_args(checker, model, k)
+            inv = {}
+            for platform in ("cpu", "tpu"):
+                lowered = jax.jit(jax.vmap(step)).trace(*args).lower(
+                    lowering_platforms=(platform,)
+                )
+                inv[platform] = op_inventory(lowered.as_text())
+            return diff_lowering_inventories(name, inv["cpu"], inv["tpu"])
+
+        return name, run
+
+    for k in MUX_KS:
+        out.append(make_kernel(k))
+        for dedup in ("sorted", "hash"):
+            out.append(make_engine(k, dedup))
+    out.append(make_lowering(MUX_KS[0]))
+    return out
+
+
 def _census_surface(
     specs: Optional[List[str]] = None,
 ) -> Tuple[str, Callable[[], List[Finding]]]:
@@ -577,6 +677,9 @@ def build_sweep(full: bool = False) -> List[Tuple[str, Callable[[], List[Finding
     out.append(_fused_surface("2pc:3", "delta"))
     if full:
         out.append(_fused_surface("paxos:2,3", "sorted"))
+    # The multiplexed superstep (worker.py --mux): batched-kernel pins at
+    # the MUX_KS lane counts plus one cross-backend lowering diff.
+    out.extend(_mux_surfaces())
     out.extend(_sharded_surfaces())
     out.extend(_ops_surfaces())
     out.extend(_pallas_surfaces())

@@ -69,6 +69,15 @@ COUNTER_KEYS = frozenset(
         "orphans_killed",
         "artifacts_swept",
         "jobs_evacuated",
+        # batched scheduling (xla_mux.py; docs/service.md "Batched
+        # scheduling") — mux_groups/mux_lanes count groups/members the
+        # pool launched, mux_dispatches_saved the device calls the
+        # batching avoided (both the pool's fold of worker summaries and
+        # the per-lane engine snapshots carry the latter). mux_lanes and
+        # mux_lanes_active on a LIVE MuxChecker snapshot are gauges (the
+        # batch's current width), so only the monotonic keys ride here.
+        "mux_groups",
+        "mux_dispatches_saved",
         # fleet counters (FLEET_COUNTERS; service/fleet.py)
         "routed",
         "migrations",

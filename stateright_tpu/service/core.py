@@ -101,6 +101,9 @@ SERVICE_COUNTERS = (
     "orphans_killed",
     "artifacts_swept",
     "jobs_evacuated",
+    "mux_groups",
+    "mux_lanes",
+    "mux_dispatches_saved",
 )
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
@@ -219,6 +222,15 @@ class ServiceConfig:
     #: tick) does ONE stat per artifact per tick instead of one per
     #: render.
     snapshot_age_ttl_s: float = 1.0
+    # -- batched scheduling (stateright_tpu/xla_mux.py; docs/service.md
+    # -- "Batched scheduling") --------------------------------------------
+    #: Multiplex up to K queued same-spec batch jobs into ONE
+    #: ``worker.py --mux`` invocation (per-lane journal events, budgets,
+    #: checkpoints, and metrics preserved; a mux worker fault requeues
+    #: its members individually, solo). 1 = off. None = the ``STPU_MUX``
+    #: env knob (default 1). Only families in ``registry.MUX_FAMILIES``
+    #: group; everything else keeps the solo path.
+    mux_k: Optional[int] = None
 
 
 class Job:
@@ -271,6 +283,18 @@ class Job:
         self.swept = False  #: run-dir artifacts removed by the retention sweep
         self.checker = None  #: interactive jobs only
         self.dir: Optional[str] = None
+        #: Live/last mux-group membership ({"group", "lanes", "lane"}):
+        #: rides snapshot() so /.pool and the dashboard attribute a
+        #: member's rates to its lane, never to the whole batch.
+        self.mux: Optional[Dict[str, Any]] = None
+        #: The group heartbeat path while a mux attempt runs — the
+        #: snapshot() liveness readout for members (one heartbeat serves
+        #: the whole batch; cleared at settlement so a later solo attempt
+        #: reads its own hb.json again).
+        self._mux_hb: Optional[str] = None
+        #: A failed mux attempt pins its unfinished members solo: the
+        #: requeued attempt must not regroup into the same faulty batch.
+        self._mux_solo = False
         self._proc = None  #: live worker Popen (close-with-kill path)
         self._attempt_t0: Optional[float] = None  #: monotonic; live attempt
         #: path -> (age, read_at_monotonic): the snapshot() mtime memo
@@ -354,13 +378,19 @@ class Job:
             # docs/observability.md "Dashboard"): None when the artifact
             # does not exist (host-engine jobs, swept dirs, heartbeat off).
             # Memoized per poll tick (snapshot_age_ttl_s).
+            # A mux member's liveness is the GROUP heartbeat (one worker
+            # beats for the whole batch) while its attempt runs.
             "heartbeat_age_s": (
-                self._cached_age(self._path("hb.json")) if self.dir else None
+                self._cached_age(self._mux_hb or self._path("hb.json"))
+                if self.dir
+                else None
             ),
             "checkpoint_age_s": (
                 self._cached_age(self.checkpoint_path) if self.dir else None
             ),
         }
+        if self.mux is not None:
+            out["mux"] = self.mux
         if self.result is not None:
             out["result"] = {
                 k: self.result.get(k)
@@ -604,6 +634,11 @@ class CheckerService:
         self._cfg = config or ServiceConfig(**overrides)
         if self._cfg.compile_cache is None:
             self._cfg.compile_cache = os.path.abspath(".jax_cache")
+        if self._cfg.mux_k is None:
+            try:
+                self._cfg.mux_k = max(1, int(os.environ.get("STPU_MUX", "1")))
+            except ValueError:
+                self._cfg.mux_k = 1
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._jobs: Dict[str, Job] = {}
@@ -1428,11 +1463,59 @@ class CheckerService:
                         # (submit, requeue, job settlement, close)
                         # notifies, so an untimed wait suffices.
                         self._cond.wait()
-            for job in to_start:
-                threading.Thread(
-                    target=self._run_job, args=(job,),
-                    name=f"stpu-service-{job.id}", daemon=True,
-                ).start()
+                groups = self._mux_partition(to_start)
+            for unit in groups:
+                if len(unit) == 1:
+                    threading.Thread(
+                        target=self._run_job, args=(unit[0],),
+                        name=f"stpu-service-{unit[0].id}", daemon=True,
+                    ).start()
+                else:
+                    threading.Thread(
+                        target=self._run_mux_group, args=(unit,),
+                        name=f"stpu-service-mux-{unit[0].id}", daemon=True,
+                    ).start()
+
+    def _mux_partition(self, to_start: List[Job]) -> List[List[Job]]:
+        """Partition a scheduling round's picks into mux groups (same
+        spec, up to ``mux_k`` lanes) and solo singletons. Caller holds
+        the lock (the eligibility checks read breaker state).
+
+        Grouping rules (docs/service.md "Batched scheduling"): the
+        batching is opt-in (``mux_k > 1``), device-path only (an open
+        breaker's host fallback stays solo), spec families must be
+        statically mux-eligible (``registry.MUX_FAMILIES`` — shipped
+        families only; the worker still verifies at resolve time and
+        falls back to sequential drive on a typed ``MuxError``), and a
+        member whose previous mux attempt faulted retries solo
+        (``_mux_solo``). Migration seeds (``seed_checkpoint``) stay solo
+        too: a migrated-in job's adopted rotation can arrive at grown
+        capacities the fresh sibling lanes don't share."""
+        if self._cfg.mux_k <= 1 or self._breaker != "closed":
+            return [[job] for job in to_start]
+
+        def eligible(job: Job) -> bool:
+            if job.engine_force is not None or job.seed_checkpoint:
+                return False
+            if job._mux_solo:
+                return False
+            try:
+                family = registry.parse(job.spec)[0]
+            except ValueError:  # pragma: no cover - admission validated
+                return False
+            return family in registry.MUX_FAMILIES
+
+        groups: List[List[Job]] = []
+        by_spec: Dict[str, List[Job]] = {}
+        for job in to_start:
+            if eligible(job):
+                by_spec.setdefault(job.spec, []).append(job)
+            else:
+                groups.append([job])
+        for members in by_spec.values():
+            for at in range(0, len(members), self._cfg.mux_k):
+                groups.append(members[at:at + self._cfg.mux_k])
+        return groups
 
     def _worker_env(self, job: Job, device: bool) -> Dict[str, str]:
         env = dict(os.environ)
@@ -1710,6 +1793,326 @@ class CheckerService:
                     "completed", job=job.id, status="failed",
                     error=job.error, result=None,
                 )
+            self._cond.notify_all()
+
+    def _run_mux_group(self, jobs: List[Job]) -> None:
+        """One supervised multiplexed attempt of ``jobs`` (same spec,
+        one ``worker.py --mux`` process; docs/service.md "Batched
+        scheduling"). Mirrors :meth:`_run_job`'s crash contract: any
+        unexpected supervisor exception settles every still-owned member
+        as failed rather than leaking ``max_inflight`` slots."""
+        try:
+            self._run_mux_group_inner(jobs)
+        except Exception as e:  # noqa: BLE001 - the verdict IS the handling
+            with self._cond:
+                for job in jobs:
+                    job._proc = None
+                    job._mux_hb = None
+                    if job.status != "running":
+                        continue
+                    job.status = "failed"
+                    job.error = f"supervisor error: {type(e).__name__}: {e}"
+                    job.completed_unix_ts = time.time()
+                    self._counters.inc("jobs_failed")
+                    self._jlog(
+                        "completed", job=job.id, status="failed",
+                        error=job.error, result=None,
+                    )
+                self._cond.notify_all()
+
+    def _run_mux_group_inner(self, jobs: List[Job]) -> None:
+        cfg = self._cfg
+        lead = jobs[0]
+        spec = lead.spec
+        attempts = {job.id: len(job.attempts) for job in jobs}
+        gid = f"mux-{lead.id}-a{attempts[lead.id]}"
+
+        def requeue_solo(members: List[Job]) -> None:
+            # Back to the queue WITHOUT burning a requeue: these members
+            # did nothing wrong — the batch (breaker race, a sibling's
+            # exhausted budget) did. The journal needs no extra event: a
+            # `started` with no terminal already replays as requeue.
+            for job in members:
+                if job.status == "running":
+                    job.status = "queued"
+                    job._mux_solo = True
+
+        with self._cond:
+            jobs = [j for j in jobs if j.status == "running"]
+            if not jobs:
+                self._cond.notify_all()
+                return
+        device = self._breaker == "closed"
+        if not device:
+            # The breaker tripped between the scheduler's pick and here:
+            # batching is a device-path optimization — hand the members
+            # back for the solo path's host-fallback/halt semantics.
+            with self._cond:
+                requeue_solo(jobs)
+                self._cond.notify_all()
+            return
+        live: List[Job] = []
+        with self._cond:
+            for job in jobs:
+                if job.status != "running":
+                    continue
+                if job.max_seconds - job.consumed_s <= 0:
+                    job.status = "failed"
+                    job.error = "wall-clock budget exhausted"
+                    job.completed_unix_ts = time.time()
+                    self._counters.inc("jobs_failed")
+                    self._jlog(
+                        "completed", job=job.id, status="failed",
+                        error=job.error, result=None,
+                    )
+                    continue
+                live.append(job)
+            self._cond.notify_all()
+        jobs = live
+        if not jobs:
+            return
+        # The group budget is the tightest member's remaining wall-clock:
+        # the batch never overruns ANY member. A sibling with budget left
+        # when the soft exit fires re-queues uncharged-requeue (below).
+        remaining = min(job.max_seconds - job.consumed_s for job in jobs)
+        resumes = {
+            job.id: latest_valid_checkpoint(job.checkpoint_path)
+            for job in jobs
+        }
+        manifest = {
+            "group": gid,
+            "spec": spec,
+            "lanes": [
+                {
+                    "job": job.id,
+                    "out": job._path("result.json"),
+                    "checkpoint": job.checkpoint_path,
+                    "metrics": job.metrics_path,
+                    "resume": resumes[job.id],
+                    "max_states": job.max_states,
+                    "chaos": {
+                        key: job.chaos.get(key)
+                        for key in ("die_at_depth", "freeze_at_depth", "marker")
+                    },
+                }
+                for job in jobs
+            ],
+        }
+        manifest_path = lead._path(f"mux-manifest-a{attempts[lead.id]}.json")
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh)
+        os.replace(tmp, manifest_path)
+        hb_path = lead._path("mux-hb.json")
+        argv = [
+            sys.executable, _WORKER,
+            "--mux", manifest_path,
+            "--spec", spec,
+            "--engine", "xla",
+            "--platform", cfg.platform,
+            "--out", lead._path("mux-result.json"),
+            "--every", str(cfg.checkpoint_every),
+            "--keep", str(cfg.checkpoint_keep),
+            "--max-seconds", str(remaining),
+        ]
+        if cfg.device_ordinal is not None:
+            argv += ["--device", str(cfg.device_ordinal)]
+
+        def on_spawn(proc):
+            # Same close/evacuate race contract as the solo path — every
+            # member carries the (shared) proc handle so close() and
+            # evacuate() kill the batch through any member, and every
+            # member journals its own `started` (the mux provenance keys
+            # ride along; replay ignores unknown keys).
+            with self._cond:
+                closed = self._closed
+                migrated = False
+                for job in jobs:
+                    job._proc = proc
+                    if job.status == "migrated":
+                        migrated = True
+                        continue
+                    self._jlog(
+                        "started", job=job.id, attempt=attempts[job.id],
+                        engine="xla", resumed_from=resumes[job.id],
+                        pid=proc.pid, mux_group=gid, mux_lanes=len(jobs),
+                    )
+            if closed or migrated:
+                sup._kill_group(proc)
+
+        with self._cond:
+            if self._closed:
+                for job in jobs:
+                    if job.status != "running":
+                        continue
+                    job.status = "failed"
+                    job.error = "service closed"
+                    self._counters.inc("jobs_failed")
+                self._cond.notify_all()
+                return
+            if any(job.status == "migrated" for job in jobs):
+                # Evacuate raced the spawn: the whole pool is condemned
+                # (evacuate sweeps every non-terminal batch job) — don't
+                # start a worker on the dead device.
+                self._cond.notify_all()
+                return
+            self._counters.inc("mux_groups")
+            self._counters.inc("mux_lanes", len(jobs))
+            now = time.monotonic()
+            for i, job in enumerate(jobs):
+                job.engine = "xla"
+                job.resumed_from = resumes[job.id]
+                job._attempt_t0 = now
+                job._mux_hb = hb_path
+                job.mux = {"group": gid, "lanes": len(jobs), "lane": i}
+        self.log(
+            f"{gid} lanes={[j.id for j in jobs]} attempt engine=xla"
+        )
+        res = sup.run_worker(
+            argv,
+            heartbeat=hb_path,
+            # Same verdict-ordering contract as the solo path: soft
+            # budget exit first, heartbeat wedge verdict second, hard
+            # timeout as the backstop.
+            timeout_s=remaining * 1.5 + 60.0 + cfg.stall_s * 3.0,
+            stall_s=cfg.stall_s,
+            startup_grace_s=cfg.startup_grace_s,
+            poll_s=cfg.poll_s,
+            env=self._worker_env(lead, True),
+            stdout_path=lead._path(f"mux-worker{attempts[lead.id]}.out"),
+            log=self.log,
+            on_spawn=on_spawn,
+        )
+        summary = None
+        try:
+            with open(lead._path("mux-result.json")) as fh:
+                summary = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            summary = None
+        results: Dict[str, Any] = {}
+        for job in jobs:
+            # Per-lane results are written the moment a lane finishes —
+            # read them even when the worker died: finished members
+            # settle done across a mid-batch crash (a stale file cannot
+            # exist: a member with a result would have settled done).
+            try:
+                with open(job._path("result.json")) as fh:
+                    results[job.id] = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                pass
+        with self._cond:
+            for job in jobs:
+                job._proc = None
+                job._attempt_t0 = None
+                job._mux_hb = None
+            live = [j for j in jobs if j.status != "migrated"]
+            if not live:
+                # Evacuated mid-attempt (the fleet killed the worker):
+                # the siblings own every member now.
+                self._cond.notify_all()
+                return
+            if summary is not None:
+                self._counters.inc(
+                    "mux_dispatches_saved",
+                    int(summary.get("dispatches_saved") or 0),
+                )
+            for job in live:
+                # Budget: a finished lane's charge is ITS lane wall-clock
+                # (the worker stamps per-lane seconds); an unfinished
+                # member rode the whole attempt. Wedge time stays
+                # uncharged, exactly the solo contract.
+                seconds = (
+                    results[job.id].get("seconds", res.seconds)
+                    if job.id in results
+                    else res.seconds
+                )
+                if not res.wedged:
+                    job.consumed_s += float(seconds)
+                job.attempts.append(
+                    {
+                        "rc": res.rc,
+                        "killed": res.killed,
+                        "seconds": seconds,
+                        "engine": "xla",
+                        "wedged": res.wedged,
+                        "resumed_from": resumes[job.id],
+                        "mux_group": gid,
+                    }
+                )
+                self._jlog(
+                    "budget_charged", job=job.id, seconds=seconds,
+                    consumed_s=job.consumed_s, charged=not res.wedged,
+                )
+            if self._closed:
+                for job in live:
+                    job.status = "failed"
+                    job.error = "service closed"
+                    self._counters.inc("jobs_failed")
+                self._cond.notify_all()
+                return
+            finished = [j for j in live if j.id in results]
+            unfinished = [j for j in live if j.id not in results]
+            for job in finished:
+                job.status = "done"
+                job.result = results[job.id]
+                job.completed_unix_ts = time.time()
+                self._counters.inc("jobs_done")
+                self._jlog(
+                    "completed", job=job.id, status="done", error=None,
+                    result=job.persist()["result"],
+                )
+            if finished:
+                self._consecutive_wedges = 0
+                self._sweep_artifacts()
+            if unfinished:
+                for job in unfinished:
+                    job._mux_solo = True
+                if res.wedged:
+                    # ONE device incident (one worker, one wedge) for the
+                    # breaker's evidence; each member still records the
+                    # wedged attempt it rode.
+                    self._counters.inc("wedge_verdicts")
+                    self._record_wedge()
+                    for job in unfinished:
+                        job.wedges += 1
+                        self._requeue_or_fail(
+                            job, f"mux wedge verdict: {res.killed}",
+                            wedged=True,
+                        )
+                elif res.crashed:
+                    self._counters.inc("crashes")
+                    for job in unfinished:
+                        self._requeue_or_fail(
+                            job,
+                            f"mux worker died by signal (rc={res.rc})",
+                            wedged=False,
+                        )
+                elif res.killed is not None or res.rc == 3:
+                    # The GROUP budget (the tightest member) expired.
+                    # Members whose own budget is spent fail; siblings
+                    # with wall-clock left retry solo, no requeue burned.
+                    for job in unfinished:
+                        if job.max_seconds - job.consumed_s <= 0:
+                            job.status = "failed"
+                            job.error = "wall-clock budget exhausted"
+                            job.completed_unix_ts = time.time()
+                            self._counters.inc("jobs_failed")
+                            self._jlog(
+                                "completed", job=job.id, status="failed",
+                                error=job.error, result=None,
+                            )
+                        else:
+                            requeue_solo([job])
+                else:
+                    for job in unfinished:
+                        job.status = "failed"
+                        job.error = f"mux worker exited rc={res.rc}"
+                        job.completed_unix_ts = time.time()
+                        self._counters.inc("jobs_failed")
+                        self._jlog(
+                            "completed", job=job.id, status="failed",
+                            error=job.error, result=None,
+                        )
             self._cond.notify_all()
 
     def _requeue_or_fail(

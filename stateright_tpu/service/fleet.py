@@ -351,6 +351,10 @@ class FleetService:
             self._ensure_monitor()
 
     def _pool_config(self, i: int) -> ServiceConfig:
+        # Everything not overridden below inherits from the caller's pool
+        # template — notably mux_k, so a batching fleet multiplexes
+        # same-spec jobs WITHIN each device's pool (routing stays
+        # whole-job; lanes never span devices).
         base = self._cfg.pool or ServiceConfig()
         return dataclasses.replace(
             base,

@@ -98,6 +98,18 @@ FAMILIES: Dict[str, Callable[[List[int]], Tuple[Any, Dict[str, int]]]] = {
 }
 
 
+#: Families whose jobs the batching scheduler may multiplex into one
+#: ``worker.py --mux`` invocation (docs/service.md "Batched scheduling").
+#: ``MuxChecker`` requires lanes with no host-verified properties — every
+#: shipped family resolves hv-free at its shipped configurations EXCEPT
+#: ``scr``, whose model conditionally promotes properties to host
+#: verification by pattern census, so the scheduler excludes it statically
+#: rather than paying a resolve-and-fall-back in the worker. User families
+#: (STPU_FAMILIES) are never multiplexed: the service cannot see their
+#: model structure without importing user code.
+MUX_FAMILIES = frozenset(FAMILIES) - {"scr"}
+
+
 def _extra_family_targets() -> Dict[str, Tuple[str, str]]:
     """The ``STPU_FAMILIES="name=module:attr,..."`` mapping, parsed but
     NOT imported — :func:`parse` validates spec names against this

@@ -39,6 +39,22 @@ repeat-wedge shape the breaker tests need.
 
 At completion the counts/discoveries/metrics land in ``--out`` (atomic
 write) for the service to parse.
+
+Multiplexed mode (``--mux manifest.json``; docs/service.md "Batched
+scheduling"): ONE worker drives K same-spec jobs through the batched
+fused engine (``stateright_tpu/xla_mux.py``). The manifest carries one
+lane entry per member job — its own ``out``/``checkpoint``/``metrics``/
+``resume`` paths, ``max_states``, and chaos flags — and the worker
+resolves the spec ONCE, spawns K lane checkers over the shared model,
+and steps a :class:`MuxChecker`. Each lane's ``result.json`` is written
+the moment that lane finishes (so a crash mid-batch loses only the
+unfinished lanes — the service settles finished members done and
+requeues the rest), and ``--out`` receives a group summary
+(``dispatches``/``dispatches_saved``) the service folds into its mux
+counters. A spec that turns out mux-ineligible at resolve time (typed
+``MuxError`` — e.g. lanes resuming at diverged capacities) falls back to
+driving the lanes sequentially in this same process: same per-lane
+results, no batching win, never a failure.
 """
 
 from __future__ import annotations
@@ -73,6 +89,183 @@ def _enable_compile_cache() -> None:
         print(f"compile cache unavailable: {e}", file=sys.stderr)
 
 
+def _lane_armed(chaos: dict) -> bool:
+    """Whether a lane's sabotage flags are live (marker = exactly-once)."""
+    if (
+        chaos.get("die_at_depth") is None
+        and chaos.get("freeze_at_depth") is None
+    ):
+        return False
+    marker = chaos.get("marker")
+    return marker is None or not os.path.exists(marker)
+
+
+def _lane_trip(chaos: dict) -> None:
+    marker = chaos.get("marker")
+    if marker is not None:
+        with open(marker, "w") as fh:
+            fh.write("tripped\n")
+
+
+def _mux_main(args, device_label) -> int:
+    """The ``--mux`` body: K lanes of one spec through the batched fused
+    engine (falling back to sequential solo drive on ``MuxError``)."""
+    import jax
+
+    from stateright_tpu.service.registry import resolve
+    from stateright_tpu.xla_mux import MuxChecker, MuxError
+
+    with open(args.mux) as fh:
+        manifest = json.load(fh)
+    lanes_cfg = manifest["lanes"]
+    model, caps = resolve(args.spec)
+    chaos_armed = [_lane_armed(lane.get("chaos") or {}) for lane in lanes_cfg]
+    checkers = []
+    for i, lane in enumerate(lanes_cfg):
+        builder = model.checker()
+        if lane.get("max_states"):
+            builder = builder.target_state_count(lane["max_states"])
+        kw = dict(caps)
+        if any(chaos_armed):
+            # Same contract as solo chaos runs: one level per dispatch so
+            # sabotage depths and checkpoint cadence line up — for EVERY
+            # lane, since the batch shares one dispatch cadence.
+            kw["levels_per_dispatch"] = 1
+        if lane.get("checkpoint"):
+            kw.update(
+                checkpoint_to=lane["checkpoint"],
+                checkpoint_every=args.every,
+                checkpoint_keep=args.keep,
+            )
+        if lane.get("metrics"):
+            kw["metrics_to"] = lane["metrics"]
+        if lane.get("resume"):
+            kw["checkpoint"] = lane["resume"]
+        checkers.append(builder.spawn_xla(**kw))
+    start_depths = [ln._depth for ln in checkers]
+    t0 = time.monotonic()
+
+    def over_budget() -> bool:
+        return (
+            args.max_seconds is not None
+            and time.monotonic() - t0 > args.max_seconds
+        )
+
+    try:
+        mux = MuxChecker(checkers)
+    except MuxError as e:
+        # Graceful degradation: same process, same per-lane artifacts,
+        # sequential device calls — the batch loses its win, not its jobs.
+        print(f"mux ineligible, driving lanes solo: {e}", file=sys.stderr)
+        mux = None
+
+    written = [False] * len(checkers)
+
+    def write_lane(i: int) -> None:
+        ln = checkers[i]
+        lane = lanes_cfg[i]
+        metrics = dict(ln.metrics())
+        # Lane attribution (docs/observability.md "Lane telemetry"): the
+        # lane's own counts/rates, plus the batch context — a member's
+        # metrics.json never reports the whole batch's gen/s as its own.
+        metrics["mux_lanes"] = len(checkers)
+        metrics["mux_dispatches_saved"] = (
+            mux._dispatches_saved if mux is not None else 0
+        )
+        recorder = getattr(ln, "_recorder", None)
+        if recorder is not None:
+            recorder.sample(metrics, kind="engine")
+        result = {
+            "spec": args.spec,
+            "engine": "xla",
+            "platform": jax.default_backend(),
+            "device": device_label,
+            "device_ordinal": args.device,
+            "degraded": False,
+            "generated": ln.state_count(),
+            "unique": ln.unique_state_count(),
+            "max_depth": ln.max_depth(),
+            "discoveries": {
+                name: [repr(a) for a in path.into_actions()]
+                for name, path in sorted(ln.discoveries().items())
+            },
+            "resumed_from": lane.get("resume"),
+            "start_depth": start_depths[i],
+            "seconds": time.monotonic() - t0,
+            "mux": {
+                "group": manifest.get("group"),
+                "lanes": len(checkers),
+                "lane": i,
+            },
+            "metrics": metrics,
+        }
+        tmp = lane["out"] + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(result, fh, default=str)
+        os.replace(tmp, lane["out"])
+        written[i] = True
+
+    def lane_chaos(i: int) -> None:
+        if not chaos_armed[i]:
+            return
+        ln = checkers[i]
+        chaos = lanes_cfg[i].get("chaos") or {}
+        die = chaos.get("die_at_depth")
+        freeze = chaos.get("freeze_at_depth")
+        if die is not None and ln._depth >= die:
+            _lane_trip(chaos)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if freeze is not None and ln._depth >= freeze:
+            _lane_trip(chaos)
+            hb = mux._heartbeat if mux is not None else ln._heartbeat
+            if hb is not None:
+                hb.beat("dispatch", compile=False)
+            os.kill(os.getpid(), signal.SIGSTOP)
+
+    if mux is not None:
+        while not mux.is_done():
+            mux._run_block()
+            # Finished lanes land their results BEFORE any sabotage fires:
+            # a chaos kill mid-batch must lose only unfinished lanes.
+            for i, ln in enumerate(checkers):
+                if not written[i] and ln.is_done():
+                    write_lane(i)
+            for i in range(len(checkers)):
+                lane_chaos(i)
+            if over_budget():
+                return 3
+    else:
+        for i, ln in enumerate(checkers):
+            while not ln.is_done():
+                ln._run_block()
+                lane_chaos(i)
+                if over_budget():
+                    return 3
+            write_lane(i)
+    for i, ln in enumerate(checkers):
+        if not written[i]:
+            write_lane(i)
+    summary = {
+        "group": manifest.get("group"),
+        "spec": args.spec,
+        "engine": "xla-mux" if mux is not None else "xla",
+        "mux": mux is not None,
+        "lanes": len(checkers),
+        "dispatches": (
+            len(mux.dispatch_log)
+            if mux is not None
+            else sum(len(ln.dispatch_log) for ln in checkers)
+        ),
+        "dispatches_saved": mux._dispatches_saved if mux is not None else 0,
+        "seconds": time.monotonic() - t0,
+    }
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(summary, fh, default=str)
+    os.replace(tmp, args.out)
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--spec", required=True)  # service/registry.py grammar
@@ -97,6 +290,10 @@ def main() -> int:
     p.add_argument("--chaos-die-at-depth", type=int, default=None)
     p.add_argument("--chaos-freeze-at-depth", type=int, default=None)
     p.add_argument("--chaos-marker", default=None)
+    # Multiplexed mode: a lane manifest path (docs/service.md "Batched
+    # scheduling"). Per-lane out/checkpoint/metrics/resume/chaos ride in
+    # the manifest; --out becomes the group summary.
+    p.add_argument("--mux", default=None)
     args = p.parse_args()
 
     import jax
@@ -113,6 +310,9 @@ def main() -> int:
         if 0 <= args.device < len(devices):
             jax.config.update("jax_default_device", devices[args.device])
             device_label = str(devices[args.device])
+
+    if args.mux:
+        return _mux_main(args, device_label)
 
     from stateright_tpu.service.registry import resolve
 

@@ -134,6 +134,12 @@ function renderPool(pool) {
     `<div class="k">${pool.fleet ? "fleet" : "device"}</div></div>` +
     (pool.fleet ? `<div class="tile"><div class="v">${fmt(pool.migrations)}</div>` +
       `<div class="k">migrations</div></div>` : "") +
+    // Lane occupancy (batched scheduling; docs/service.md): mean lanes
+    // per mux group plus the device calls the batching avoided.
+    (pool.mux_groups ? `<div class="tile"><div class="v">` +
+      `${(pool.mux_lanes / pool.mux_groups).toFixed(1)}×</div>` +
+      `<div class="k">lane occupancy (${fmt(pool.mux_groups)} batches · ` +
+      `${fmt(pool.mux_dispatches_saved)} dispatches saved)</div></div>` : "") +
     (pool.journal ? `<div class="tile"><div class="v">${fmt(pool.journal.records)}</div>` +
       `<div class="k">journal records</div></div>` : "");
 
@@ -197,6 +203,10 @@ function jobCard(id, job) {
     `<h3><span class="mono">${escapeHtml(id)}</span>${statusBadge(job)}</h3>` +
     `<div class="meta">${escapeHtml(job.spec || "")} · ${escapeHtml(engine || "")}` +
     ` · ${escapeHtml(job.kind || "batch")}` +
+    // Mux membership: the lane this member rode (rates on this card are
+    // the LANE's own — the batch total lives in the pool tiles).
+    (job.mux ? ` · lane ${(job.mux.lane || 0) + 1}/${job.mux.lanes}` +
+      ` of ${escapeHtml(job.mux.group || "")}` : "") +
     (job.wedges ? ` · ${job.wedges} wedge${job.wedges > 1 ? "s" : ""}` : "") +
     (job.requeues ? ` · ${job.requeues} requeue${job.requeues > 1 ? "s" : ""}` : "") +
     `</div>` +

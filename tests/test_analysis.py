@@ -335,12 +335,16 @@ def test_stpu008_flags_one_sided_pathology_op():
 def test_stpu008_shipped_kernels_lower_identically():
     """Both width classes' transition kernels produce identical
     pathology-op inventories on cpu and tpu lowerings (the integration
-    form; the sweep runs this surface by default)."""
+    form; the sweep runs these surfaces by default — the solo kernel
+    and the ISSUE 16 batched mux superstep)."""
     reports = {r.name: r for r in run_sweep(only=["lower:2pc:3"])}
-    assert set(reports) == {"lower:2pc:3:packed_step"}
-    rep = reports["lower:2pc:3:packed_step"]
-    assert rep.error == "", rep.error
-    assert rep.findings == [], [f.message for f in rep.findings]
+    assert set(reports) == {
+        "lower:2pc:3:packed_step",
+        "lower:2pc:3:mux-superstep:k2",
+    }
+    for rep in reports.values():
+        assert rep.error == "", rep.error
+        assert rep.findings == [], [f.message for f in rep.findings]
 
 
 # --- the sharded mesh engine is a traced surface -----------------------------

@@ -1,0 +1,326 @@
+"""Multiplexed-superstep pins (ISSUE 16).
+
+``stateright_tpu/xla_mux.py`` claims each lane of a K-job batched fused
+dispatch is bit-identical to that job's solo run — counts, depths, and
+discoveries — while paying the per-level fixed cost (sort + dispatch)
+once for the whole batch. These tests pin that claim and the machinery
+around it:
+
+- **Exactness**: >=3 packed models x both non-delta dedup structures,
+  every lane vs its solo ground truth; stragglers (per-lane state-count /
+  depth targets, including a lane that is done at spawn) ride masked
+  without perturbing siblings; K=1 degenerates bit-identically.
+- **The ISSUE acceptance pin**: K=8 same-spec rm<=3 jobs through one mux
+  = >=3x fewer device dispatches than 8 solo runs, counts bit-identical.
+- **Typed ineligibility**: every ``MuxError`` precondition.
+- **Lane telemetry** (docs/observability.md "Lane telemetry"): the mux
+  ``dispatch_log`` 4-tuples, each lane's pinned 2-tuple ``dispatch_log``
+  reconciling with its ``level_log``, per-row ``lanes``/``lanes_active``,
+  and the ``mux_dispatches_saved`` accounting.
+- **The census mux sub-dict** (STPU007, ``analysis/census.py``): opt-in,
+  family-gated, summed into the compile-shape budget.
+- **The <30s service drill** ``test_smoke_mux`` (rides in
+  ``tools/smoke.sh``): a ``mux_k`` pool batches three same-spec jobs into
+  ONE worker invocation — exact pinned counts, per-lane ``mux`` result
+  provenance, pool gauges, and journaled ``mux_group`` starts.
+"""
+
+import pytest
+
+from stateright_tpu.models.increment import PackedIncrement
+from stateright_tpu.models.increment_lock import PackedIncrementLock
+from stateright_tpu.models.single_copy_register import PackedSingleCopyRegister
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys
+from stateright_tpu.xla_mux import MuxChecker, MuxError
+
+KW = dict(frontier_capacity=1 << 10, table_capacity=1 << 13)
+
+
+def _summary(c):
+    return (
+        c.state_count(),
+        c.unique_state_count(),
+        c.max_depth(),
+        {n: p.into_actions() for n, p in c.discoveries().items()},
+    )
+
+
+def _lanes(model, k, dedup, builder=lambda b: b):
+    return [
+        builder(model.checker()).spawn_xla(dedup=dedup, **KW)
+        for _ in range(k)
+    ]
+
+
+# --- engine exactness -----------------------------------------------------
+
+
+@pytest.mark.parametrize("dedup", ["hash", "sorted"])
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: PackedTwoPhaseSys(3),
+        lambda: PackedIncrement(3),
+        lambda: PackedIncrementLock(3),
+    ],
+    ids=["2pc", "increment", "increment-lock"],
+)
+def test_mux_lanes_bit_identical_to_solo(factory, dedup):
+    model = factory()
+    solo = _summary(model.checker().spawn_xla(dedup=dedup, **KW).join())
+    lanes = _lanes(model, 3, dedup)
+    mux = MuxChecker(lanes)
+    mux.run_to_completion()
+    assert mux.is_done()
+    for ln in lanes:
+        assert _summary(ln) == solo
+    assert mux.state_count() == 3 * solo[0]
+    assert mux.unique_state_count() == 3 * solo[1]
+
+
+@pytest.mark.parametrize("dedup", ["hash", "sorted"])
+def test_mux_straggler_lanes(dedup):
+    """Uneven lane lifetimes: a depth-capped lane, a state-count-capped
+    lane, a lane that is DONE at spawn (its init already meets the
+    target), and an uncapped lane — each must finish bit-identical to a
+    solo run with the same target, masked out without perturbing the
+    lanes still running."""
+    model = PackedTwoPhaseSys(3)
+    shapers = [
+        lambda b: b.target_max_depth(2),
+        lambda b: b.target_state_count(40),
+        lambda b: b.target_state_count(1),  # done after its first level
+        lambda b: b,
+    ]
+    solos = [
+        _summary(sh(model.checker()).spawn_xla(dedup=dedup, **KW).join())
+        for sh in shapers
+    ]
+    lanes = [
+        sh(model.checker()).spawn_xla(dedup=dedup, **KW) for sh in shapers
+    ]
+    mux = MuxChecker(lanes)
+    mux.run_to_completion()
+    assert [_summary(ln) for ln in lanes] == solos
+    # The stragglers genuinely stopped early (targets are
+    # level-granular, so the earliest lane still commits one level).
+    assert lanes[0].max_depth() < lanes[3].max_depth()
+    assert (
+        lanes[2].state_count()
+        < 40
+        <= lanes[1].state_count()
+        < lanes[3].state_count()
+    )
+
+
+def test_mux_k1_degenerates_bit_identically():
+    model = PackedIncrementLock(3)
+    solo = _summary(model.checker().spawn_xla(**KW).join())
+    lane = model.checker().spawn_xla(**KW)
+    mux = MuxChecker([lane])
+    mux.run_to_completion()
+    assert _summary(lane) == solo
+    assert all(lanes == 1 for _, _, lanes, _ in mux.dispatch_log)
+    # A single lane saves nothing; the accounting must say so.
+    assert mux.metrics()["mux_dispatches_saved"] == 0
+
+
+def test_mux_dispatch_acceptance_k8():
+    """The ISSUE 16 acceptance criterion: K=8 same-spec rm<=3 jobs via
+    mux take >=3x fewer device dispatches than 8 solo runs, with every
+    lane's counts bit-identical to its solo run."""
+    model = PackedTwoPhaseSys(3)
+    solos = [model.checker().spawn_xla(**KW).join() for _ in range(8)]
+    solo_dispatches = sum(len(c.dispatch_log) for c in solos)
+    solo = _summary(solos[0])
+    assert all(_summary(c) == solo for c in solos[1:])
+
+    lanes = _lanes(model, 8, "auto")
+    mux = MuxChecker(lanes)
+    mux.run_to_completion()
+    for ln in lanes:
+        assert _summary(ln) == solo
+    assert len(mux.dispatch_log) * 3 <= solo_dispatches, (
+        mux.dispatch_log,
+        solo_dispatches,
+    )
+
+
+# --- typed ineligibility --------------------------------------------------
+
+
+def test_mux_error_pins():
+    model = PackedTwoPhaseSys(3)
+    with pytest.raises(MuxError, match="at least one lane"):
+        MuxChecker([])
+    ln = model.checker().spawn_xla(**KW)
+    with pytest.raises(MuxError, match="distinct"):
+        MuxChecker([ln, ln])
+    with pytest.raises(MuxError, match="ONE model"):
+        MuxChecker([ln, PackedTwoPhaseSys(3).checker().spawn_xla(**KW)])
+    with pytest.raises(MuxError, match="disagree on dedup"):
+        MuxChecker(
+            [ln, model.checker().spawn_xla(dedup="sorted", **KW)]
+        )
+    with pytest.raises(MuxError, match="capacities"):
+        MuxChecker(
+            [
+                ln,
+                model.checker().spawn_xla(
+                    frontier_capacity=1 << 9, table_capacity=1 << 13
+                ),
+            ]
+        )
+    with pytest.raises(MuxError, match="delta"):
+        MuxChecker([model.checker().spawn_xla(dedup="delta", **KW)])
+    with pytest.raises(MuxError, match="visitors"):
+        MuxChecker(
+            [model.checker().visitor(lambda path: None).spawn_xla(**KW)]
+        )
+
+
+class _HvSingleCopy(PackedSingleCopyRegister):
+    """The shipped scr model with a property demoted to host
+    verification — the structure ``registry.MUX_FAMILIES`` excludes
+    statically and ``_check_lanes`` rejects typed."""
+
+    host_verified_properties = frozenset({"linearizable"})
+
+
+def test_mux_error_host_verified():
+    model = _HvSingleCopy(2, 1)
+    lane = model.checker().spawn_xla(
+        frontier_capacity=1 << 10, table_capacity=1 << 12
+    )
+    with pytest.raises(MuxError, match="host-verified"):
+        MuxChecker([lane])
+
+
+def test_mux_families_exclude_conditionally_host_verified():
+    from stateright_tpu.service.registry import FAMILIES, MUX_FAMILIES
+
+    assert "scr" not in MUX_FAMILIES
+    assert MUX_FAMILIES == frozenset(FAMILIES) - {"scr"}
+
+
+# --- lane telemetry -------------------------------------------------------
+
+
+def test_mux_lane_telemetry():
+    model = PackedTwoPhaseSys(3)
+    lanes = _lanes(model, 2, "auto")
+    mux = MuxChecker(lanes)
+    mux.run_to_completion()
+    # Mux dispatch_log: (run_cap, committed, lanes, lanes_active).
+    assert mux.dispatch_log
+    for run_cap, committed, k, active in mux.dispatch_log:
+        assert k == 2 and 0 <= active <= k and committed >= 0
+    # Each lane keeps the engine's pinned 2-tuple schema, reconciling
+    # with its own level_log (the tests/test_obs.py invariant).
+    for ln in lanes:
+        assert all(len(t) == 2 for t in ln.dispatch_log)
+        assert sum(c for _, c in ln.dispatch_log) == len(ln.level_log)
+        for row in ln.level_log:
+            assert {
+                "bucket", "cand_cap", "lane_words", "lanes", "lanes_active"
+            } <= set(row)
+            assert row["lanes"] == 2
+            assert 1 <= row["lanes_active"] <= 2
+    m = mux.metrics()
+    assert m["engine"] == "xla-mux"
+    assert m["mux_lanes"] == 2
+    assert m["mux_lanes_active"] == 0
+    assert m["dispatches"] == len(mux.dispatch_log)
+    assert m["mux_dispatches_saved"] == sum(
+        max(0, active - 1) for _, _, _, active in mux.dispatch_log
+    )
+    assert m["mux_dispatches_saved"] >= 1
+
+
+# --- the STPU007 census sub-dict ------------------------------------------
+
+
+def test_census_mux_shapes_opt_in_and_family_gated():
+    from stateright_tpu.analysis.census import census_findings, plan_for
+
+    solo = plan_for("2pc:3", "tpu")
+    assert "mux" not in solo
+    plan = plan_for("2pc:3", "tpu", mux_k=4)
+    assert plan["mux"]["k"] == 4
+    # One batched program per solo bucket — the mux engine has no
+    # in-program cand ladder, so its shape class is (k, bucket, cand_cap).
+    assert [s["bucket"] for s in plan["mux"]["shapes"]] == [
+        s["bucket"] for s in plan["shapes"]
+    ]
+    assert plan["mux"]["distinct_programs"] == plan["distinct_programs"]
+    # The solo half of a mux-enabled plan is unchanged (warm_cache's
+    # derivation and the banked compile_plan.json stay stable).
+    assert {k: v for k, v in plan.items() if k != "mux"} == solo
+    # Statically ineligible family: no mux sub-dict even when asked.
+    assert "mux" not in plan_for("scr:3,1", "tpu", mux_k=4)
+    # STPU007 prices the TOTAL: solo programs + batched programs.
+    tight = dict(plan, budget=plan["distinct_programs"])
+    findings = census_findings({"specs": {"2pc:3": {"tpu": tight}}})
+    assert [f.rule for f in findings] == ["STPU007"]
+    assert not census_findings({"specs": {"2pc:3": {"tpu": solo}}})
+
+
+# --- the service drill (tools/smoke.sh) -----------------------------------
+
+
+def test_smoke_mux(tmp_path):
+    """The tier-0 batching drill: three same-spec jobs co-queued in a
+    ``mux_k=3`` pool run as ONE ``worker.py --mux`` invocation — exact
+    pinned counts per member, per-lane ``mux`` provenance in each
+    result, pool gauges, and journaled ``mux_group`` starts."""
+    from stateright_tpu.service import CheckerService, ServiceConfig
+    from stateright_tpu.service.journal import read_journal
+
+    cfg = ServiceConfig(
+        run_dir=str(tmp_path / "svc"),
+        platform="cpu",
+        # Closed pool while submitting: the scheduler is event-driven,
+        # so with open slots the first submission could start solo
+        # before its siblings are queued. Deterministic co-queuing =
+        # submit into zero slots, then open the pool once.
+        max_inflight=0,
+        mux_k=3,
+        default_max_seconds=240.0,
+        stall_s=8.0,
+        startup_grace_s=240.0,
+        poll_s=0.2,
+        backoff_s=0.1,
+        probe_auto=False,
+        admission_lint=False,
+    )
+    svc = CheckerService(cfg)
+    try:
+        jobs = [svc.submit("2pc:3") for _ in range(3)]
+        with svc._cond:
+            cfg.max_inflight = 3
+            svc._cond.notify_all()
+        for job in jobs:
+            assert job.wait(timeout=240), job.snapshot()
+            assert job.status == "done", job.error
+            assert (job.result["generated"], job.result["unique"]) == (
+                1_146, 288,
+            )
+            assert job.result["mux"]["lanes"] == 3
+            assert job.result["metrics"]["mux_lanes"] == 3
+        assert len({j.result["mux"]["group"] for j in jobs}) == 1
+        g = svc.gauges()
+        assert g["mux_groups"] == 1
+        assert g["mux_lanes"] == 3
+        assert g["mux_dispatches_saved"] >= 1
+        started = [
+            r
+            for r in read_journal(
+                str(tmp_path / "svc" / "journal.jsonl")
+            ).records
+            if r.get("event") == "started"
+        ]
+        assert started and all(
+            r.get("mux_group") and r.get("mux_lanes") == 3 for r in started
+        )
+    finally:
+        svc.close()

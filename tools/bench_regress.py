@@ -109,6 +109,16 @@ def load_trajectory(archive_dir: str) -> Dict[str, Dict[str, Any]]:
             entry["best"] = float(line["value"])
             entry["best_metric"] = line["metric"]
             entry["best_file"] = os.path.basename(path)
+        # Batched-scheduling baseline (BENCH_MUX; docs/service.md
+        # "Batched scheduling"): archived rounds that ran the mux
+        # throughput probe carry its row — the per-platform best
+        # jobs_per_sec becomes the mux trajectory. Absent everywhere
+        # until a round banks one (the mux check skips, no_baseline-safe).
+        mux = (doc.get("mux") if isinstance(doc, dict) else None) or line.get("mux")
+        if isinstance(mux, dict) and mux.get("jobs_per_sec"):
+            if float(mux["jobs_per_sec"]) > entry.get("mux_best", 0.0):
+                entry["mux_best"] = float(mux["jobs_per_sec"])
+                entry["mux_best_file"] = os.path.basename(path)
     return out
 
 
@@ -125,6 +135,7 @@ def normalize_fresh(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "resumed": doc.get("resumed"),
             "lint_ok": doc.get("lint_ok"),
             "fleet": doc.get("fleet"),
+            "mux": doc.get("mux"),
             "full_coverage": doc.get("count_ok") is not None,
             "metric": doc["metric"],
         }
@@ -137,6 +148,7 @@ def normalize_fresh(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "resumed": resume.get("phase"),
             "lint_ok": doc.get("lint_ok"),
             "fleet": doc.get("fleet"),
+            "mux": doc.get("mux"),
             "full_coverage": doc.get("full_coverage"),
             "metric": f"bench_detail rm={doc.get('rm')}",
         }
@@ -237,6 +249,45 @@ def judge(
             )
         )
 
+    # -- batched-scheduling throughput (BENCH_MUX) -------------------------
+    mux = fresh.get("mux")
+    if isinstance(mux, dict):
+        if mux.get("error") or mux.get("jobs_failed"):
+            checks.append(
+                _check(
+                    "mux", "fail",
+                    "mux throughput probe "
+                    + (f"errored: {mux['error']}" if mux.get("error") else
+                       f"lost {mux['jobs_failed']} of {mux.get('k')} jobs"),
+                )
+            )
+        elif base is None or not base.get("mux_best"):
+            checks.append(
+                _check(
+                    "mux", "skip",
+                    f"no archived {platform} mux baseline yet "
+                    f"({mux.get('jobs_per_sec')} jobs/s at k={mux.get('k')}, "
+                    f"{mux.get('dispatches_per_job')} dispatches/job); "
+                    "banking this one starts the trajectory",
+                )
+            )
+        else:
+            floor = base["mux_best"] * (1.0 - tolerance)
+            ok = float(mux.get("jobs_per_sec", 0.0)) >= floor
+            checks.append(
+                _check(
+                    "mux", "pass" if ok else "fail",
+                    f"{mux.get('jobs_per_sec')} jobs/s at k={mux.get('k')} "
+                    f"vs {platform} mux best {base['mux_best']} "
+                    f"({base.get('mux_best_file')}); floor {floor:.3f} at "
+                    f"tolerance {tolerance}",
+                    value=mux.get("jobs_per_sec"), baseline=base["mux_best"],
+                    floor=round(floor, 3),
+                )
+            )
+    # No "skip" row when the probe never ran: the mux mode is an env
+    # opt-in (BENCH_MUX), not a default stage of every bench.
+
     # -- chaos SLO line ----------------------------------------------------
     if chaos is None:
         checks.append(
@@ -295,7 +346,7 @@ def judge(
         "platform": platform,
         "fresh": {k: fresh.get(k) for k in
                   ("metric", "value", "count_ok", "resumed", "lint_ok",
-                   "fleet")},
+                   "fleet", "mux")},
         "baseline": base,
         "platforms_archived": sorted(trajectory),
         "tolerances": {

@@ -33,8 +33,16 @@ Scenarios (``--scenario``):
   kills ONE device's pool mid-schedule: its jobs must migrate and
   complete exactly once on surviving devices, bit-identical to the
   undisturbed baseline (ISSUE 15 acceptance).
-- ``all``       — baseline + kill + torn (+ device_lost when --fleet)
-  (the acceptance sweep).
+- ``mux`` (``--mux K``) — SIGKILL the MULTIPLEXED worker mid-batch
+  (ISSUE 16): K same-spec jobs through a ``mux_k=K`` pool, one member
+  carrying a seeded ``worker.die`` lane sabotage that kills the shared
+  group process; every member must requeue solo, resume from its own
+  lane checkpoints, and complete exactly once — bit-identical to a
+  mux-OFF baseline of the same schedule (the batched path proves itself
+  against the solo engine, not merely against itself). Self-contained:
+  it builds its own same-spec schedule and solo baseline.
+- ``all``       — baseline + kill + torn (+ device_lost when --fleet,
+  + mux when --mux) (the acceptance sweep).
 
 Fleet mode (``--fleet N``): the serve child fronts N per-device pools
 through :class:`FleetService` behind the SAME submit/wait_all surface;
@@ -62,6 +70,7 @@ Usage::
 
     python tools/service_chaos.py --seed 42                # all scenarios
     python tools/service_chaos.py --seed 7 --scenario kill --jobs 3
+    python tools/service_chaos.py --seed 7 --scenario mux --mux 4
     python tools/service_chaos.py --seed 7 --check-repro
 
 ``tools/tpu_watch.sh service_chaos`` is the watcher stage alias; the
@@ -170,6 +179,10 @@ def serve(args: argparse.Namespace) -> int:
     cfg = ServiceConfig(
         run_dir=args.run_dir,
         platform="cpu",
+        # Batched scheduling (ISSUE 16): the mux scenario's incarnations
+        # run the pool with mux_k=K so same-spec members fold into one
+        # worker.py --mux group.
+        mux_k=args.mux or None,
         max_inflight=args.max_inflight,
         max_queue=max(8, len(schedule["jobs"]) + 2),
         # Every restart recovery compacts once (one rotation per
@@ -220,6 +233,9 @@ def serve(args: argparse.Namespace) -> int:
                 entry["spec"],
                 max_seconds=entry["max_seconds"],
                 idempotency_key=entry["idem"],
+                # Per-job worker sabotage (the mux scenario arms its
+                # members directly; absent everywhere else).
+                chaos=entry.get("chaos"),
             )
             stats.write(
                 json.dumps(
@@ -458,6 +474,7 @@ def run_incarnation(
     wait_s: float = 300.0,
     fleet: int = 0,
     sessions: int = 0,
+    mux: int = 0,
 ) -> int:
     """Spawn one ``--serve`` child (its own process group) and either let
     it finish or SIGKILL the whole group after ``kill_after_s`` — the
@@ -470,6 +487,8 @@ def run_incarnation(
     ]
     if fleet:
         argv += ["--fleet", str(fleet)]
+    if mux:
+        argv += ["--mux", str(mux)]
     if sessions:
         argv += ["--sessions", str(sessions)]
     if chaos:
@@ -837,6 +856,113 @@ def run_scenario(
     return report
 
 
+def run_mux_scenario(
+    seed: int,
+    base_dir: str,
+    k: int,
+    *,
+    max_seconds: float = 240.0,
+    wait_s: float = 300.0,
+    max_restarts: int = 4,
+) -> Dict[str, Any]:
+    """SIGKILL the multiplexed worker mid-batch (ISSUE 16). K same-spec
+    jobs through a ``mux_k=K`` pool; EVERY member carries a per-job
+    ``die_at_depth`` (marker-once, so each job sabotages exactly one
+    attempt) — whichever members the scheduler batches, the first lane
+    to reach the depth kills the SHARED group process. Pool-level
+    ``worker.die`` can't guarantee that: the seeded victim may start
+    solo before siblings arrive, and the kill then proves nothing about
+    the batch path. The service must quarantine every member
+    individually, retry them solo (resuming from their own lane
+    checkpoint rotations), and converge to exactly-once — counts
+    bit-identical to a mux-OFF solo baseline of the same schedule
+    (chaos stripped), which this scenario runs first (the batched
+    engine proves itself against the solo one)."""
+    import random
+    import zlib
+
+    rng = random.Random((seed << 8) ^ zlib.crc32(b"mux"))
+    faults = {"die_depth": rng.randint(2, 4), "armed": "every member"}
+
+    def make_schedule(with_chaos: bool) -> Dict[str, Any]:
+        jobs = []
+        for i in range(k):
+            job = {
+                "idem": f"mux-{seed}-{i}",
+                "spec": "2pc:3",
+                # Zero stagger: members must be co-queued for the
+                # scheduler to batch them at all.
+                "delay_s": 0.0,
+                "max_seconds": max_seconds,
+            }
+            if with_chaos:
+                job["chaos"] = {
+                    "die_at_depth": faults["die_depth"], "marker": True,
+                }
+            jobs.append(job)
+        return {"seed": seed, "jobs": jobs}
+
+    schedule = make_schedule(False)
+    t0 = time.monotonic()
+
+    def incarnate(sub: str, sched: Dict[str, Any], **kw) -> tuple:
+        run_dir = os.path.join(base_dir, sub)
+        os.makedirs(run_dir, exist_ok=True)
+        sp = os.path.join(run_dir, "schedule.json")
+        with open(sp, "w") as fh:
+            json.dump(sched, fh)
+        return run_dir, run_incarnation(run_dir, sp, wait_s=wait_s, **kw)
+
+    base_run, rc = incarnate("mux_baseline", schedule, max_inflight=2)
+    if rc != 0:
+        return {
+            "scenario": "mux", "ok": False, "rc": rc, "k": k,
+            "faults": faults, "problems": [f"mux baseline rc={rc}"],
+        }
+    reference = reference_counts(base_run, schedule)
+    restarts = 0
+    run_dir, rc = incarnate(
+        "mux", make_schedule(True), mux=k, max_inflight=max(2, k),
+    )
+    while rc != 0 and restarts < max_restarts:
+        restarts += 1
+        _, rc = incarnate(
+            "mux", make_schedule(True), mux=k, max_inflight=max(2, k),
+        )
+    if rc != 0:
+        return {
+            "scenario": "mux", "ok": False, "rc": rc, "k": k,
+            "restarts": restarts, "faults": faults,
+            "problems": [f"final incarnation rc={rc}"],
+        }
+    invariant = check_invariant(run_dir, schedule, reference)
+    history = journal_history(run_dir)
+    groups = {
+        r["mux_group"]
+        for r in history
+        if r["event"] == "started" and r.get("mux_group")
+    }
+    report = {
+        "scenario": "mux",
+        "ok": invariant["ok"],
+        "problems": invariant["problems"],
+        "faults": faults,
+        "k": k,
+        "restarts": restarts,
+        "mux_groups_started": len(groups),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+        **slo_stats(run_dir),
+    }
+    if not groups:
+        # A mux pass that never batched proves nothing — same contract
+        # as device_lost's no-migrations guard.
+        report["ok"] = False
+        report["problems"] = report["problems"] + [
+            "mux scenario journaled no mux_group starts"
+        ]
+    return report
+
+
 def reference_counts(run_dir: str, schedule: Dict[str, Any]) -> dict:
     """spec -> result counts from the baseline scenario's results."""
     with open(os.path.join(run_dir, "driver_results.json")) as fh:
@@ -887,10 +1013,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--jobs", type=int, default=3)
     p.add_argument("--scenario", default="all",
                    choices=("all", "baseline", "kill", "die", "torn",
-                            "device_lost"))
+                            "device_lost", "mux"))
     p.add_argument("--fleet", type=int, default=0,
                    help="front N per-device pools (FleetService); 0 = "
                         "the single-pool service")
+    p.add_argument("--mux", type=int, default=0,
+                   help="run the mux scenario at K lanes (batching "
+                        "scheduler, ServiceConfig.mux_k); 0 = off "
+                        "(--scenario mux alone defaults K to 4)")
     p.add_argument("--sessions", type=int, default=0,
                    help="concurrent interactive Explorer sessions "
                         "polling /.status alongside the batch schedule")
@@ -926,6 +1056,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "jobs": args.jobs,
         "fleet_devices": args.fleet or None,
         "sessions": args.sessions or None,
+        "mux_k": args.mux or None,
         "specs": [j["spec"] for j in schedule["jobs"]],
         "scenarios": {},
         "ok": True,
@@ -935,13 +1066,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         line["scenarios"]["repro"] = rep
         line["ok"] = line["ok"] and rep["ok"]
     else:
-        names = (
-            ["baseline", "kill", "torn"]
-            + (["device_lost"] if args.fleet else [])
-            if args.scenario == "all"
-            else ["baseline"]
-            + ([args.scenario] if args.scenario != "baseline" else [])
-        )
+        if args.scenario == "mux" and not args.mux:
+            args.mux = 4
+        if args.scenario == "mux":
+            names = []  # self-contained: builds its own schedule+baseline
+        elif args.scenario == "all":
+            names = ["baseline", "kill", "torn"] + (
+                ["device_lost"] if args.fleet else []
+            )
+        else:
+            names = ["baseline"] + (
+                [args.scenario] if args.scenario != "baseline" else []
+            )
         reference = None
         kw = dict(
             max_inflight=args.max_inflight,
@@ -965,6 +1101,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
             elif name == "baseline":
                 break  # no ground truth; the comparisons are meaningless
+        if args.mux and args.scenario in ("all", "mux"):
+            rep = run_mux_scenario(
+                args.seed, base_dir, args.mux,
+                max_seconds=args.max_seconds,
+                wait_s=args.wait_s,
+                max_restarts=args.max_restarts,
+            )
+            line["scenarios"]["mux"] = rep
+            line["ok"] = line["ok"] and rep["ok"]
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as fh:

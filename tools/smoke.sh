@@ -18,6 +18,10 @@
 # and the <30s FLEET FAILOVER drill (a 2-device FleetService;
 # device.lost kills one device's pool mid-job, the victim migrates to
 # the survivor and completes bit-identical — the fleet tier's tier-0
+# proof), and the <30s MUX BATCHING drill (a mux_k=3 pool runs three
+# co-queued same-spec jobs as ONE worker.py --mux invocation — exact
+# pinned counts per member, per-lane mux provenance, pool gauges,
+# journaled mux_group starts — the batched-scheduling tier's tier-0
 # proof).
 # A red here means don't bother starting the full run.
 #
@@ -48,4 +52,5 @@ exec timeout -k 10 480 python -m pytest \
   tests/test_service.py::test_smoke_service_kill_resume \
   tests/test_service.py::test_smoke_fleet_failover \
   tests/test_service_durability.py::test_smoke_service_restart_resume \
+  tests/test_mux.py::test_smoke_mux \
   -x -q -p no:cacheprovider "$@"

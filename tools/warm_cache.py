@@ -23,6 +23,14 @@ Usage::
     python tools/warm_cache.py                 # the censused shipped specs
     python tools/warm_cache.py --specs 2pc:4 paxos:2,3
     python tools/warm_cache.py --platform cpu  # warm the CPU cache (CI)
+    python tools/warm_cache.py --mux 4         # + the K=4 batched programs
+
+``--mux K`` additionally banks the multiplexed-superstep programs a
+service running with ``STPU_MUX=K`` compiles (the census's ``mux`` shape
+classes — ``plan_for(..., mux_k=K)``): after each eligible spec's solo
+warm, one K-lane ``worker.py --mux`` group of that spec runs to
+completion, landing the batched (k, bucket, cand_cap) programs in the
+same cache. Specs outside ``registry.MUX_FAMILIES`` warm solo only.
 
 Emits one JSON line per spec and a final summary. Re-running is cheap:
 already-cached programs load in seconds, so this doubles as a cache
@@ -98,6 +106,11 @@ def main() -> int:
                    help="mid-dispatch heartbeat leash (3x while compiling)")
     p.add_argument("--cache-dir", default=os.path.join(REPO, ".jax_cache"))
     p.add_argument("--out-dir", default=os.path.join(REPO, "runs", "warm"))
+    p.add_argument(
+        "--mux", type=int, default=0, metavar="K",
+        help="also pre-warm the K-lane multiplexed programs "
+             "(one worker.py --mux group per MUX_FAMILIES spec)",
+    )
     args = p.parse_args()
 
     if args.specs is None:
@@ -148,6 +161,61 @@ def main() -> int:
             )
         summary.append(row)
         print(json.dumps(row), flush=True)
+
+    if args.mux > 1:
+        from stateright_tpu.service.registry import MUX_FAMILIES
+
+        for spec in args.specs:
+            if parse(spec)[0] not in MUX_FAMILIES:
+                continue
+            tag = spec.replace(":", "_").replace(",", "-")
+            lanes = []
+            for i in range(args.mux):
+                lanes.append({
+                    "job": f"warm-{tag}-l{i}",
+                    "out": os.path.join(
+                        args.out_dir, f"warm_{tag}_mux_l{i}.json"
+                    ),
+                })
+            manifest = os.path.join(args.out_dir, f"warm_{tag}_mux.json")
+            with open(manifest, "w") as fh:
+                json.dump(
+                    {"group": f"warm-mux-{tag}", "spec": spec,
+                     "lanes": lanes}, fh,
+                )
+            t0 = time.monotonic()
+            res = sup.run_worker(
+                [
+                    sys.executable, WORKER,
+                    "--mux", manifest,
+                    "--spec", spec,
+                    "--engine", "xla",
+                    "--platform", args.platform,
+                    "--out", os.path.join(
+                        args.out_dir, f"warm_{tag}_mux_group.json"
+                    ),
+                    "--max-seconds", str(args.budget_s),
+                ],
+                heartbeat=os.path.join(
+                    args.out_dir, f"warm_{tag}_mux_hb.json"
+                ),
+                timeout_s=args.budget_s * 1.5 + 60.0,
+                stall_s=args.stall_s,
+                startup_grace_s=600.0,
+                poll_s=1.0,
+                env=env,
+                stdout_path=os.path.join(args.out_dir, f"warm_{tag}_mux.out"),
+            )
+            row = {
+                "spec": spec,
+                "mux": args.mux,
+                "ok": res.ok,
+                "seconds": round(time.monotonic() - t0, 2),
+                "killed": res.killed,
+                "rc": res.rc,
+            }
+            summary.append(row)
+            print(json.dumps(row), flush=True)
 
     ok = sum(1 for r in summary if r["ok"])
     print(
