@@ -108,7 +108,11 @@ class CheckerBuilder:
         device dispatch so watchdogs can tell a wedged tunnel from a
         long XLA compile (env ``STPU_HEARTBEAT``). Both off by default;
         neither adds device syncs. ``checker.metrics()`` returns the
-        unified counters/gauges snapshot either way.
+        unified counters/gauges snapshot either way. ``phases=True``
+        (env ``STPU_PHASES=1``, needs a live tracer) turns on the
+        dispatch-phase profiler: each device call splits into
+        host_prep/enqueue/device_compute/readback sub-spans plus a
+        ``checker.phase_log`` row (``tools/roofline.py --phases``).
 
         With ``mesh`` (a ``jax.sharding.Mesh`` with one axis, more than one
         device), the frontier and visited set shard by fingerprint ownership

@@ -293,6 +293,23 @@ class ExplorerApp:
         with open(path, "rb") as fh:
             return 200, fh.read()
 
+    def merged_trace(self) -> Tuple[int, Any]:
+        """``GET /.trace.json`` — the service/fleet's whole merged
+        distributed-trace timeline (``obs.collect`` over the run dir: one
+        Chrome trace, per-process tracks, flow arrows per trace id). Like
+        :meth:`job_trace`, the 200 body is the mtime-cached export's raw
+        bytes."""
+        if self._service is None:
+            return 404, "no service attached"
+        merger = getattr(self._service, "merged_trace_chrome", None)
+        if merger is None:
+            return 404, "service has no merged trace surface"
+        path = merger()
+        if path is None:
+            return 404, "no span traces in the run dir (tracing off?)"
+        with open(path, "rb") as fh:
+            return 200, fh.read()
+
     def metrics_text(self) -> str:
         """``GET /.metrics`` — the OpenMetrics exposition of this session
         plus (when service-backed) the pool gauges and every pool job's
@@ -300,7 +317,7 @@ class ExplorerApp:
         (``stateright_tpu/obs/promexport.py``; docs/observability.md
         "/.metrics"). Counters match ``checker.metrics()`` exactly —
         pinned by tests/test_promexport.py and the smoke stage's scrape."""
-        samples: List[promexport.Sample] = []
+        samples: List[promexport.Sample] = [promexport.build_info_sample()]
         with self._lock:
             own = self._checker.metrics()
         own_label = self._job.id if self._job is not None else "interactive"
@@ -601,6 +618,12 @@ class _ExplorerHandler(BaseHTTPRequestHandler):
         elif path.startswith("/.jobs/") and path.endswith("/trace.json"):
             job_id = path[len("/.jobs/"):-len("/trace.json")]
             code, body = self.explorer_app.job_trace(job_id)
+            if code == 200:
+                self._send(200, body, "application/json")
+            else:
+                self._send(code, str(body).encode(), "text/plain")
+        elif path == "/.trace.json":
+            code, body = self.explorer_app.merged_trace()
             if code == 200:
                 self._send(200, body, "application/json")
             else:

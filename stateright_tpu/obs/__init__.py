@@ -48,19 +48,34 @@ from __future__ import annotations
 import os
 from typing import Optional, Union
 
+from . import collect
 from .heartbeat import Heartbeat
 from .metrics import Counters
 from .timeseries import MetricsRecorder, read_series
-from .trace import NULL_TRACER, Span, Tracer, export_chrome
+from .trace import (
+    CTX_ENV,
+    NULL_TRACER,
+    Span,
+    Tracer,
+    export_chrome,
+    format_ctx,
+    new_trace_id,
+    parse_ctx,
+)
 
 __all__ = [
+    "CTX_ENV",
     "Counters",
     "Heartbeat",
     "MetricsRecorder",
     "NULL_TRACER",
     "Span",
     "Tracer",
+    "collect",
     "export_chrome",
+    "format_ctx",
+    "new_trace_id",
+    "parse_ctx",
     "read_series",
     "resolve_heartbeat",
     "resolve_recorder",

@@ -190,6 +190,44 @@ def _fmt_value(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+#: Memoized build-info labels: the package tree hash walks every source
+#: file — compute once per process (the tree cannot change under a
+#: running service), not once per scrape.
+_BUILD_INFO_LABELS: Optional[Dict[str, str]] = None
+
+
+def build_info_sample(platform: Optional[str] = None) -> Sample:
+    """The ``stpu_build_info`` identity gauge (value always 1; the
+    standard Prometheus *info*-metric idiom): ``platform`` (the live jax
+    backend unless the caller knows better), ``jax`` (version), and
+    ``tree`` — the package-tree content hash the stpu-lint cache keys by
+    (``analysis/cache.tree_hash``), so a scrape ties metrics to the exact
+    source the service is running."""
+    global _BUILD_INFO_LABELS
+    if _BUILD_INFO_LABELS is None:
+        import jax
+
+        try:
+            from ..analysis.cache import tree_hash
+
+            tree = tree_hash()[:12]
+        except Exception:  # noqa: BLE001 - identity is best-effort
+            tree = "unknown"
+        _BUILD_INFO_LABELS = {
+            "jax": getattr(jax, "__version__", "unknown"),
+            "tree": tree,
+        }
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return (
+        "stpu_build_info",
+        {"platform": str(platform), **_BUILD_INFO_LABELS},
+        1.0,
+    )
+
+
 def render_openmetrics(samples: List[Sample]) -> str:
     """One OpenMetrics exposition of ``samples``: a ``# TYPE`` line per
     family (counter families carry the ``_total``-stripped family name,

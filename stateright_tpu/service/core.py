@@ -74,7 +74,13 @@ from typing import Any, Dict, List, Optional, Sequence
 from .. import chaos as chaos_mod
 from .. import supervise as sup
 from ..checkpoint import latest_valid_checkpoint
-from ..obs import Counters, export_chrome
+from ..obs import (
+    NULL_TRACER,
+    Counters,
+    export_chrome,
+    new_trace_id,
+    resolve_tracer,
+)
 from . import registry
 from .journal import Journal, read_journal
 
@@ -231,6 +237,14 @@ class ServiceConfig:
     #: env knob (default 1). Only families in ``registry.MUX_FAMILIES``
     #: group; everything else keeps the solo path.
     mux_k: Optional[int] = None
+    # -- distributed tracing (docs/observability.md) -----------------------
+    #: Service-side span trace: ``True`` appends the pool's own spans
+    #: (``submit``/``attempt``) to ``<run_dir>/trace.jsonl``, a path
+    #: appends there; ``None`` defers to the ``STPU_SERVICE_TRACE`` env
+    #: knob ("1" = the run-dir default, else a path; unset = off).
+    #: Every submission mints (and journals) a ``trace_id`` regardless —
+    #: tracing off only skips the span writes, never the propagation.
+    trace: Any = None
 
 
 class Job:
@@ -280,6 +294,14 @@ class Job:
         self.created_unix_ts = time.time()
         self.completed_unix_ts: Optional[float] = None
         self.recovered = False  #: restored from a journal replay
+        #: The submission's distributed-trace id (docs/observability.md
+        #: "Distributed tracing") — minted at submit, journaled, carried
+        #: across requeues/restarts/migrations so every attempt's spans
+        #: stitch into one trace.
+        self.trace_id: Optional[str] = None
+        #: The root (submit) span's id — the attempt spans' parent.
+        #: None on replayed jobs (their attempts re-root at the trace).
+        self._root_sid: Optional[str] = None
         self.swept = False  #: run-dir artifacts removed by the retention sweep
         self.checker = None  #: interactive jobs only
         self.dir: Optional[str] = None
@@ -373,6 +395,7 @@ class Job:
             "lint": self.lint,
             "error": self.error,
             "recovered": self.recovered,
+            "trace_id": self.trace_id,
             # Liveness/recovery ages, host-side from file mtimes (the
             # dashboard's per-job staleness + checkpoint-age readouts;
             # docs/observability.md "Dashboard"): None when the artifact
@@ -437,6 +460,7 @@ class Job:
             ),
             "created_unix_ts": self.created_unix_ts,
             "completed_unix_ts": self.completed_unix_ts,
+            "trace_id": self.trace_id,
         }
 
     def metrics(self) -> Optional[Dict[str, Any]]:
@@ -546,6 +570,7 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "result": None,
                 "created_unix_ts": rec["ts"],
                 "completed_unix_ts": None,
+                "trace_id": rec.get("trace_id"),
             }
             state["jobs"][jid] = job
             state["order"].append(jid)
@@ -574,6 +599,9 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             job["pid"] = rec.get("pid")
             job["engine"] = rec.get("engine", job["engine"])
             job["degraded"] = job["degraded"] or job["engine"] == "host"
+            # Older journals only carried the trace id on `submitted`;
+            # either event restores it (migration resubmits stamp both).
+            job["trace_id"] = rec.get("trace_id", job.get("trace_id"))
         elif ev == "budget_charged":
             job["consumed_s"] = rec.get("consumed_s", job["consumed_s"])
             job["pid"] = None  # the attempt was reaped; no orphan to kill
@@ -670,6 +698,18 @@ class CheckerService:
         self._idem: Dict[str, str] = {}
         self._journal: Optional[Journal] = None
         self._recovery: Optional[Dict[str, Any]] = None
+        # Distributed tracing (docs/observability.md "Distributed
+        # tracing"): the pool's own span file. NULL_TRACER when off —
+        # trace ids still mint/journal/propagate either way.
+        trace_cfg = self._cfg.trace
+        if trace_cfg is None:
+            raw = os.environ.get("STPU_SERVICE_TRACE") or None
+            trace_cfg = True if raw == "1" else raw
+        if trace_cfg is True:
+            trace_cfg = os.path.join(self._cfg.run_dir, "trace.jsonl")
+        self._tracer = (
+            resolve_tracer(trace_cfg) if trace_cfg else NULL_TRACER
+        )
         if self._cfg.chaos:
             # The deterministic fault layer: installed process-wide for
             # the service-side seams (journal writer, run_worker polls)
@@ -855,6 +895,11 @@ class CheckerService:
                 job.requeues = int(rec.get("requeues", 0))
                 job.wedges = int(rec.get("wedges", 0))
                 job.error = rec.get("error")
+                # Trace continuity across restarts: the requeued attempt
+                # keeps journaling/propagating the submission's trace id
+                # (its spans re-root at the trace — the old root span
+                # lives in the previous incarnation's file).
+                job.trace_id = rec.get("trace_id")
                 status = rec["status"]
                 if status in ("done", "failed", "migrated"):
                     # Journal-complete: restore the terminal verdict,
@@ -1171,6 +1216,7 @@ class CheckerService:
         engine: str = "auto",
         spent_s: float = 0.0,
         resume_from: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Job:
         """Queues one batch checking job; returns its :class:`Job` handle
         or raises :class:`AdmissionError` (queue full → carries
@@ -1194,10 +1240,16 @@ class CheckerService:
         while open); ``spent_s`` seeds the wall-clock already charged on
         a previous device; ``resume_from`` seeds a sibling pool's
         checkpoint rotation, adopted until this job writes rotations of
-        its own."""
+        its own.
+
+        ``trace_id`` joins an existing distributed trace (the fleet
+        passes its minted id; migration passes the victim's) instead of
+        minting a fresh one — docs/observability.md "Distributed
+        tracing"."""
         if engine not in ("auto", "host"):
             raise ValueError(f"engine must be 'auto' or 'host', got {engine!r}")
         registry.parse(spec)  # typed spec validation, pre-admission
+        _t0 = time.monotonic()
         with self._lock:
             # Pre-flight closed check: a closed pool must reject
             # immediately (the old contract), not after a cold lint
@@ -1296,6 +1348,9 @@ class CheckerService:
             job.engine_force = "host" if engine == "host" else None
             job.consumed_s = max(0.0, float(spent_s))
             job.seed_checkpoint = resume_from
+            # Trace ids mint UNCONDITIONALLY (journaled, surfaced in
+            # /.pool) — only span WRITES are gated on the tracer.
+            job.trace_id = trace_id or new_trace_id()
             job.dir = os.path.join(self._ensure_session_dir(), job.id)
             os.makedirs(job.dir, exist_ok=True)
             if job.chaos.get("marker") is True:
@@ -1335,6 +1390,7 @@ class CheckerService:
                 engine_force=job.engine_force,
                 spent_s=job.consumed_s or None,
                 seed_checkpoint=job.seed_checkpoint,
+                trace_id=job.trace_id,
             )
             self._jlog(
                 "admitted",
@@ -1343,6 +1399,17 @@ class CheckerService:
             )
             self._ensure_scheduler()
             self._cond.notify_all()
+        if self._tracer.enabled:
+            # Root span of the submission's trace — the attempt spans'
+            # parent. Emitted outside the lock (one appended JSONL
+            # line); the id is what run_worker exports downstream.
+            job._root_sid = self._tracer.emit(
+                "submit",
+                t0=_t0,
+                dur=time.monotonic() - _t0,
+                attrs={"job": job.id, "spec": spec},
+                trace_id=job.trace_id,
+            )
         return job
 
     def check_session_capacity(self) -> None:
@@ -1522,7 +1589,8 @@ class CheckerService:
         # Scrub inherited run-trace/recovery env: per-job artifacts must
         # never alias an outer run's files.
         for key in (
-            "STPU_TRACE", "STPU_TRACE_CHROME", "STPU_HEARTBEAT",
+            "STPU_TRACE", "STPU_TRACE_CHROME", "STPU_TRACE_CTX",
+            "STPU_HEARTBEAT",
             "STPU_CHECKPOINT_TO", "STPU_CHECKPOINT_EVERY",
             "STPU_CHECKPOINT_KEEP", "STPU_METRICS_TO",
             "STPU_METRICS_EVERY", "STPU_METRICS_KEEP",
@@ -1657,6 +1725,7 @@ class CheckerService:
                     self._jlog(
                         "started", job=job.id, attempt=attempt,
                         engine=engine, resumed_from=resume, pid=proc.pid,
+                        trace_id=job.trace_id,
                     )
             if closed or migrated:
                 sup._kill_group(proc)
@@ -1699,6 +1768,9 @@ class CheckerService:
             stdout_path=job._path(f"worker{attempt}.out"),
             log=self.log,
             on_spawn=on_spawn,
+            tracer=self._tracer,
+            trace_ctx=(job.trace_id, job._root_sid) if job.trace_id else None,
+            trace_attrs={"job": job.id, "attempt": attempt, "engine": engine},
         )
         result = None
         if res.ok:
@@ -1890,6 +1962,7 @@ class CheckerService:
                     "metrics": job.metrics_path,
                     "resume": resumes[job.id],
                     "max_states": job.max_states,
+                    "trace_id": job.trace_id,
                     "chaos": {
                         key: job.chaos.get(key)
                         for key in ("die_at_depth", "freeze_at_depth", "marker")
@@ -1936,6 +2009,7 @@ class CheckerService:
                         "started", job=job.id, attempt=attempts[job.id],
                         engine="xla", resumed_from=resumes[job.id],
                         pid=proc.pid, mux_group=gid, mux_lanes=len(jobs),
+                        trace_id=job.trace_id,
                     )
             if closed or migrated:
                 sup._kill_group(proc)
@@ -1982,6 +2056,14 @@ class CheckerService:
             stdout_path=lead._path(f"mux-worker{attempts[lead.id]}.out"),
             log=self.log,
             on_spawn=on_spawn,
+            tracer=self._tracer,
+            trace_ctx=(
+                (lead.trace_id, lead._root_sid) if lead.trace_id else None
+            ),
+            trace_attrs={
+                "job": lead.id, "group": gid,
+                "lanes": len(jobs), "engine": "xla",
+            },
         )
         summary = None
         try:
@@ -2336,6 +2418,32 @@ class CheckerService:
                     return False
                 self._cond.wait(timeout=remaining)
         return True
+
+    @property
+    def run_dir(self) -> str:
+        return self._cfg.run_dir
+
+    def merged_trace_chrome(self, out_path: Optional[str] = None) -> Optional[str]:
+        """The whole pool's merged distributed-trace timeline
+        (``obs.collect`` over the run dir: service + every job/lane span
+        file, flow arrows per trace id) as Perfetto-loadable Chrome trace
+        JSON; returns the output path, or None when nothing traced.
+        Mtime-cached like :meth:`job_trace_chrome` — the Explorer's
+        ``GET /.trace.json`` polls this."""
+        from ..obs import collect as collect_mod
+
+        files = collect_mod.trace_files(self._cfg.run_dir)
+        if not files:
+            return None
+        dst = out_path or os.path.join(self._cfg.run_dir, "trace.merged.json")
+        try:
+            dst_m = os.stat(dst).st_mtime
+            fresh = all(os.stat(p).st_mtime <= dst_m for p in files)
+        except OSError:
+            fresh = False
+        if not fresh:
+            collect_mod.write(self._cfg.run_dir, dst)
+        return dst
 
     def gauges(self) -> Dict[str, Any]:
         """The pool-wide snapshot without per-job payloads — what the

@@ -62,7 +62,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import chaos as chaos_mod
 from ..checkpoint import latest_valid_checkpoint
-from ..obs import Counters
+from ..obs import NULL_TRACER, Counters, new_trace_id, resolve_tracer
 from .core import AdmissionError, CheckerService, Job, ServiceConfig
 from .journal import Journal, read_journal
 
@@ -107,6 +107,12 @@ class FleetConfig:
     pool: Optional[ServiceConfig] = None
     #: Interactive sessions cap, fleet-wide (None = sum of pool caps).
     max_sessions: Optional[int] = None
+    #: Distributed tracing (docs/observability.md "Distributed tracing"):
+    #: True → fleet route/migrate spans to ``<run_dir>/trace.jsonl`` (and
+    #: each pool, unless its template says otherwise, traces to its own
+    #: run dir); a path appends there; None → ``STPU_SERVICE_TRACE`` env.
+    #: Trace ids mint and journal regardless — only span writes gate.
+    trace: Any = None
 
 
 class FleetJob:
@@ -133,6 +139,9 @@ class FleetJob:
         #: smaller fleet): enough to re-route the work from scratch.
         self._orphan_spec: Optional[str] = None
         self.created_unix_ts = time.time()
+        #: Fleet-minted distributed-trace id — stable across migrations
+        #: (every hop's pool job carries the same one).
+        self.trace_id: Optional[str] = None
 
     # -- delegation --------------------------------------------------------
 
@@ -217,6 +226,7 @@ class FleetJob:
             status=self.status,
             migrations=len(self.migrations),
             recovered=out.get("recovered", False) or self.recovered,
+            trace_id=self.trace_id or out.get("trace_id"),
         )
         return out
 
@@ -267,6 +277,7 @@ def _fleet_replay(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "pool_job": rec["pool_job"],
                 "spec": rec.get("spec"),
                 "idempotency_key": rec.get("idempotency_key"),
+                "trace_id": rec.get("trace_id"),
             }
             if fid not in state["order"]:
                 state["order"].append(fid)
@@ -328,6 +339,13 @@ class FleetService:
         self._journal: Optional[Journal] = None
         self._recovery: Optional[Dict[str, Any]] = None
         self.log = lambda msg: None
+        trace_cfg = self._cfg.trace
+        if trace_cfg is None:
+            raw = os.environ.get("STPU_SERVICE_TRACE") or None
+            trace_cfg = True if raw == "1" else raw
+        if trace_cfg is True:
+            trace_cfg = os.path.join(self._cfg.run_dir, "trace.jsonl")
+        self._tracer = (resolve_tracer(trace_cfg) if trace_cfg else NULL_TRACER)
         if self._cfg.chaos:
             chaos_mod.install(self._cfg.chaos)
         # Per-device pools. Constructed AFTER the chaos install so a
@@ -369,6 +387,12 @@ class FleetService:
             # is idempotent on a same-spec re-install, so the plan the
             # fleet installed in __init__ keeps its counters.
             chaos=self._cfg.chaos,
+            # A tracing fleet traces its pools too (each to its own run
+            # dir) unless the template pins an explicit choice.
+            trace=(
+                base.trace if base.trace is not None
+                else (True if self._tracer.enabled else None)
+            ),
         )
 
     def _device_label(self, i: int) -> str:
@@ -457,6 +481,7 @@ class FleetService:
                         j.pool_job.spec if j.pool_job else j._orphan_spec
                     ),
                     "idempotency_key": j.idempotency_key,
+                    "trace_id": j.trace_id,
                 }
                 for fid, j in self._jobs.items()
                 # A reserved-but-still-routing handle must not be
@@ -493,6 +518,7 @@ class FleetService:
                     self, fid, idempotency_key=route.get("idempotency_key")
                 )
                 fjob.recovered = True
+                fjob.trace_id = route.get("trace_id")
                 fjob.migrations = [
                     {"recovered": True}
                 ] * state["migrations"].get(fid, 0)
@@ -544,6 +570,8 @@ class FleetService:
                             fjob.pool_job is None
                             or fjob.pool_job.status == "migrated"
                         ):
+                            if fjob.trace_id is None:
+                                fjob.trace_id = job.trace_id
                             from_device = fjob.device
                             fjob.migrations.append({"recovered": True})
                             fjob.device = device
@@ -568,6 +596,7 @@ class FleetService:
                     fjob.recovered = True
                     fjob.device = device
                     fjob.pool_job = job
+                    fjob.trace_id = job.trace_id
                     self._jobs[fid] = fjob
                     self._order.append(fid)
                     self._idem[job.idempotency_key] = fid
@@ -577,6 +606,7 @@ class FleetService:
                         pool_job=job.id,
                         idempotency_key=job.idempotency_key,
                         adopted=True,
+                        trace_id=job.trace_id,
                     )
                     attached += 1
             self._recovery = {
@@ -634,6 +664,10 @@ class FleetService:
             self._next_id += 1
             fjob = FleetJob(self, f"fjob-{self._next_id:04d}",
                             idempotency_key=idempotency_key)
+            # The fleet mints the trace id — the pool job (and every
+            # migration hop's resubmission) joins it rather than minting
+            # its own, so one submission is ONE trace end to end.
+            fjob.trace_id = new_trace_id()
             self._jobs[fjob.id] = fjob
             self._order.append(fjob.id)
             if idempotency_key is not None:
@@ -665,6 +699,7 @@ class FleetService:
                         max_states=max_states,
                         chaos=chaos,
                         idempotency_key=idempotency_key,
+                        trace_id=fjob.trace_id,
                     )
                     device = i
                     break
@@ -691,6 +726,7 @@ class FleetService:
                         chaos=chaos,
                         idempotency_key=idempotency_key,
                         engine="host",
+                        trace_id=fjob.trace_id,
                     )
                     device = alive[0]
                     forced_host = True
@@ -737,8 +773,22 @@ class FleetService:
                 "routed", job=fjob.id, spec=spec, device=device,
                 pool_job=pool_job.id, idempotency_key=idempotency_key,
                 host=forced_host or None,
+                trace_id=fjob.trace_id,
             )
             landed_lost = device in self._lost
+        if self._tracer.enabled:
+            self._tracer.emit(
+                "route",
+                t0=time.monotonic(),
+                dur=0.0,
+                attrs={
+                    "job": fjob.id, "spec": spec,
+                    "device": self._device_label(device),
+                    "pool_job": pool_job.id,
+                    "host": bool(forced_host),
+                },
+                trace_id=fjob.trace_id,
+            )
         if landed_lost and not forced_host:
             # device_lost ran while we were routing (its evacuation
             # sweep predates this placement): evacuate again so the
@@ -843,6 +893,9 @@ class FleetService:
                     chaos=dict(old.chaos) or None,
                     spent_s=old.consumed_s,
                     resume_from=seed,
+                    # Migration keeps the victim's trace: the new hop's
+                    # spans stitch onto the same timeline.
+                    trace_id=fjob.trace_id or old.trace_id,
                 )
                 reason = old.error
                 requeues = old.requeues
@@ -859,7 +912,9 @@ class FleetService:
                         )
                     continue
                 seed = None
-                resume_kwargs = {}
+                resume_kwargs = (
+                    {"trace_id": fjob.trace_id} if fjob.trace_id else {}
+                )
                 reason = "orphaned by fleet restart"
                 requeues = 0
             healthy = sorted(self._healthy_devices(), key=self._pool_load)
@@ -923,8 +978,24 @@ class FleetService:
                     "migrated", job=fjob.id, from_device=from_device,
                     to_device=target, pool_job=new_job.id,
                     reason=reason, seed=seed,
+                    trace_id=fjob.trace_id,
                 )
                 landed_lost = target in self._lost
+            if self._tracer.enabled:
+                self._tracer.emit(
+                    "migrate",
+                    t0=time.monotonic(),
+                    dur=0.0,
+                    attrs={
+                        "job": fjob.id,
+                        "from_device": self._device_label(from_device)
+                        if from_device is not None else None,
+                        "device": self._device_label(target),
+                        "pool_job": new_job.id,
+                        "reason": reason,
+                    },
+                    trace_id=fjob.trace_id,
+                )
             if landed_lost and not forced_host:
                 # The target died while we migrated onto it: evacuate
                 # again — the next sweep moves the job once more.
@@ -1114,6 +1185,31 @@ class FleetService:
                          out_path: Optional[str] = None) -> Optional[str]:
         pool, job = self._pool_of(fleet_id)
         return pool.job_trace_chrome(job.id, out_path)
+
+    @property
+    def run_dir(self) -> str:
+        return self._cfg.run_dir
+
+    def merged_trace_chrome(self, out_path: Optional[str] = None) -> Optional[str]:
+        """The fleet-wide merged timeline: ``obs.collect`` over the fleet
+        run dir — the router's spans, every device pool's, every
+        job/lane's — one Chrome trace with flow arrows across routing,
+        attempts, and migration hops. Mtime-cached; the Explorer's
+        ``GET /.trace.json`` polls this."""
+        from ..obs import collect as collect_mod
+
+        files = collect_mod.trace_files(self._cfg.run_dir)
+        if not files:
+            return None
+        dst = out_path or os.path.join(self._cfg.run_dir, "trace.merged.json")
+        try:
+            dst_m = os.stat(dst).st_mtime
+            fresh = all(os.stat(p).st_mtime <= dst_m for p in files)
+        except OSError:
+            fresh = False
+        if not fresh:
+            collect_mod.write(self._cfg.run_dir, dst)
+        return dst
 
     def job_metrics_series(self, fleet_id: str,
                            window: Optional[int] = None):

@@ -107,6 +107,33 @@ def _lane_trip(chaos: dict) -> None:
             fh.write("tripped\n")
 
 
+def _job_trace():
+    """The worker's end of the distributed-trace seam
+    (docs/observability.md "Distributed tracing"): the tracer named by
+    ``STPU_TRACE`` inherits the submission context from ``STPU_TRACE_CTX``
+    (both exported by the service), and the whole process body runs under
+    ONE pre-allocated ``job`` span — engine dispatch spans parent to it
+    via ``set_parent``. Returns ``(tracer, job_sid, attempt_sid, t0)``;
+    ``job_sid`` is None when tracing or context is off."""
+    from stateright_tpu import obs
+
+    tracer = obs.resolve_tracer(None)
+    ctx = obs.parse_ctx(os.environ.get(obs.CTX_ENV))
+    if not (tracer.enabled and ctx):
+        return tracer, None, None, time.monotonic()
+    job_sid = tracer.new_span_id()
+    tracer.set_parent(job_sid)
+    return tracer, job_sid, ctx[1], time.monotonic()
+
+
+def _end_job_trace(tracer, job_sid, attempt_sid, t0, **attrs) -> None:
+    if job_sid is not None:
+        tracer.emit(
+            "job", t0=t0, dur=time.monotonic() - t0, attrs=attrs,
+            parent_id=attempt_sid, span_id=job_sid,
+        )
+
+
 def _mux_main(args, device_label) -> int:
     """The ``--mux`` body: K lanes of one spec through the batched fused
     engine (falling back to sequential solo drive on ``MuxError``)."""
@@ -115,6 +142,7 @@ def _mux_main(args, device_label) -> int:
     from stateright_tpu.service.registry import resolve
     from stateright_tpu.xla_mux import MuxChecker, MuxError
 
+    tracer, job_sid, attempt_sid, jt0 = _job_trace()
     with open(args.mux) as fh:
         manifest = json.load(fh)
     lanes_cfg = manifest["lanes"]
@@ -204,6 +232,22 @@ def _mux_main(args, device_label) -> int:
             json.dump(result, fh, default=str)
         os.replace(tmp, lane["out"])
         written[i] = True
+        if job_sid is not None and lane.get("trace_id"):
+            # Per-lane attribution in the member job's OWN trace: the
+            # lane span carries that submission's trace_id (override —
+            # the ambient context is the lead member's) parented to this
+            # group worker's job span.
+            tracer.emit(
+                "lane",
+                t0=jt0,
+                dur=time.monotonic() - jt0,
+                attrs={
+                    "lane": i, "group": manifest.get("group"),
+                    "job": lane.get("job"), "spec": args.spec,
+                },
+                parent_id=job_sid,
+                trace_id=lane["trace_id"],
+            )
 
     def lane_chaos(i: int) -> None:
         if not chaos_armed[i]:
@@ -263,6 +307,11 @@ def _mux_main(args, device_label) -> int:
     with open(tmp, "w") as fh:
         json.dump(summary, fh, default=str)
     os.replace(tmp, args.out)
+    _end_job_trace(
+        tracer, job_sid, attempt_sid, jt0,
+        spec=args.spec, engine=summary["engine"],
+        group=manifest.get("group"), lanes=len(checkers),
+    )
     return 0
 
 
@@ -316,6 +365,7 @@ def main() -> int:
 
     from stateright_tpu.service.registry import resolve
 
+    tracer, job_sid, attempt_sid, jt0 = _job_trace()
     model, caps = resolve(args.spec)
     builder = model.checker()
     if args.max_states:
@@ -429,6 +479,10 @@ def main() -> int:
     with open(tmp, "w") as fh:
         json.dump(result, fh, default=str)
     os.replace(tmp, args.out)
+    _end_job_trace(
+        tracer, job_sid, attempt_sid, jt0,
+        spec=args.spec, engine=args.engine, resumed_from=args.resume,
+    )
     return 0
 
 

@@ -49,6 +49,7 @@ from typing import Callable, List, Optional, Sequence, Union
 from . import chaos
 from . import checkpoint as ck_mod
 from .obs import heartbeat as hb_mod
+from .obs import trace as trace_mod
 
 
 def heartbeat_verdict(
@@ -169,6 +170,9 @@ def run_worker(
     poll_s: float = 5.0,
     log: Optional[Callable[[str], None]] = None,
     on_spawn: Optional[Callable[[subprocess.Popen], None]] = None,
+    tracer=None,
+    trace_ctx: Optional[tuple] = None,
+    trace_attrs: Optional[dict] = None,
 ) -> WorkerResult:
     """ONE supervised attempt of ``argv``.
 
@@ -179,13 +183,28 @@ def run_worker(
     supervises (the CPU-fallback mode: no tunnel, no wedge). Worker stdout
     goes to ``stdout_path`` (a file, not a pipe — the parent never reads
     concurrently, so a pipe could deadlock a chatty worker, and a file
-    survives for post-mortem salvage no matter how the worker dies)."""
+    survives for post-mortem salvage no matter how the worker dies).
+
+    Distributed tracing (docs/observability.md): with ``tracer`` (a live
+    :class:`stateright_tpu.obs.Tracer`) and ``trace_ctx``
+    (``(trace_id, parent_span_id)``), the attempt is recorded as ONE
+    ``attempt`` span covering spawn→exit — its span id is pre-allocated
+    and exported to the worker as ``STPU_TRACE_CTX``, so every span the
+    worker's own tracer writes joins the submission's trace with this
+    attempt as its parent. ``trace_attrs`` ride on the span (the service
+    adds ``job``/``attempt``)."""
     _log = log or (lambda msg: None)
     env = dict(os.environ if env is None else env)
     if heartbeat is not None:
         heartbeat = os.path.abspath(heartbeat)
         os.makedirs(os.path.dirname(heartbeat) or ".", exist_ok=True)
         env["STPU_HEARTBEAT"] = heartbeat
+    trace_id = parent_sid = attempt_sid = None
+    if trace_ctx is not None:
+        trace_id, parent_sid = trace_ctx
+    if tracer is not None and getattr(tracer, "enabled", False) and trace_id:
+        attempt_sid = tracer.new_span_id()
+        env[trace_mod.CTX_ENV] = trace_mod.format_ctx(trace_id, attempt_sid)
     # heartbeat=None leaves an inherited STPU_HEARTBEAT untouched: a
     # worker whose INNER watchdog is off may still beat an OUTER
     # watcher's stage file (tpu_watch.sh + BENCH_HEARTBEAT=0). Callers
@@ -251,6 +270,17 @@ def run_worker(
                 os.unlink(heartbeat)
             except OSError:
                 pass
+    if attempt_sid is not None:
+        attrs = dict(trace_attrs or {})
+        attrs.update(
+            pid=proc.pid,
+            rc=None if killed else proc.returncode,
+            killed=killed,
+        )
+        tracer.emit(
+            "attempt", t0=t0, dur=time.monotonic() - t0, attrs=attrs,
+            parent_id=parent_sid, trace_id=trace_id, span_id=attempt_sid,
+        )
     return WorkerResult(
         rc=None if killed else proc.returncode,
         killed=killed,

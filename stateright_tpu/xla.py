@@ -293,6 +293,7 @@ class XlaChecker(Checker):
         metrics_to: Any = None,
         metrics_every: Any = None,
         metrics_keep: Optional[int] = None,
+        phases: Any = None,
     ):
         import jax
 
@@ -312,6 +313,30 @@ class XlaChecker(Checker):
             metrics_to, metrics_every, metrics_keep
         )
         self._counters = obs.Counters(ENGINE_COUNTERS)
+        # Dispatch-phase profiler (docs/observability.md "Distributed
+        # tracing"): split every device call into host_prep / enqueue /
+        # device_compute / readback sub-spans. The split needs ONE extra
+        # host-side wait (block_until_ready on work already enqueued —
+        # never a new device sync beyond the commit read the loop pays
+        # anyway), so it is off by default and requires a live tracer;
+        # the off path is byte-identical to pre-profiler dispatch.
+        if phases is None:
+            phases = os.environ.get("STPU_PHASES") or None
+        if isinstance(phases, str):
+            low = phases.strip().lower()
+            if low in ("1", "on", "true", "yes"):
+                phases = True
+            elif low in ("0", "off", "false", "no", ""):
+                phases = False
+            else:
+                raise ValueError(
+                    f"phases must be on/off (STPU_PHASES), got {phases!r}"
+                )
+        self._phases = bool(phases) and self._tracer.enabled
+        #: One dict per device call (aligned with ``dispatch_log``):
+        #: bucket/flavor/compile/committed + the four phase durations in
+        #: seconds. Populated only when the profiler is on.
+        self.phase_log: List[Dict[str, Any]] = []
         # Recovery surface (stateright_tpu/checkpoint.py): in-loop
         # auto-checkpointing at superstep boundaries (the quiescent
         # points), plus the resume-provenance gauges metrics() reports.
@@ -2281,6 +2306,22 @@ class XlaChecker(Checker):
                 compile=fresh, retry=retry, dedup=self._dedup,
                 compaction=self._compaction, shrink_below=shrink_below,
             ) as _sp:
+                _pt0 = time.monotonic() if self._phases else 0.0
+                _args = (
+                    f_in,
+                    e_in,
+                    self._frontier_count,
+                    self._table,
+                    self._disc_found,
+                    self._disc_fp,
+                    jnp.int32(budget),
+                    jnp.int32(remaining),
+                    jnp.asarray(host_found),
+                    jnp.int32(shrink_below),
+                    jnp.int32(min(prev_gen, 2**31 - 1)),
+                    jnp.int32(min(prev2_gen, 2**31 - 1)),
+                )
+                _pt1 = time.monotonic() if self._phases else 0.0
                 (
                     committed,
                     nf,
@@ -2304,27 +2345,28 @@ class XlaChecker(Checker):
                     _prev2_gen,
                     _force_full,
                     n_retries,
-                ) = fn(
-                    f_in,
-                    e_in,
-                    self._frontier_count,
-                    self._table,
-                    self._disc_found,
-                    self._disc_fp,
-                    jnp.int32(budget),
-                    jnp.int32(remaining),
-                    jnp.asarray(host_found),
-                    jnp.int32(shrink_below),
-                    jnp.int32(min(prev_gen, 2**31 - 1)),
-                    jnp.int32(min(prev2_gen, 2**31 - 1)),
-                )
+                ) = fn(*_args)
+                if self._phases:
+                    # fn() returned at enqueue; one output leaf becoming
+                    # ready means the one fused program finished — a wait
+                    # on work already in flight, not an added sync.
+                    _pt2 = time.monotonic()
+                    self._jax.block_until_ready(committed)
+                    _pt3 = time.monotonic()
                 # Commit the non-overflowing prefix of the block. The
                 # int() blocks until the device program finishes, so the
                 # span covers the whole round-trip — and reuses a sync
                 # the commit below needs anyway.
                 committed = int(committed)
                 _sp.set(committed=committed)
+                _pt4 = time.monotonic() if self._phases else 0.0
             self.dispatch_log.append((run_cap, committed))
+            if self._phases:
+                self._log_phases(
+                    _sp, flavor="fused", bucket=run_cap, fresh=fresh,
+                    committed=committed,
+                    stamps=(_pt0, _pt1, _pt2, _pt3, _pt4),
+                )
             if self._heartbeat is not None:
                 self._heartbeat.commit(
                     depth=self._depth + committed,
@@ -2458,7 +2500,8 @@ class XlaChecker(Checker):
                 retry=retry, dedup=self._dedup,
                 compaction=self._compaction,
             ) as _sp:
-                out = fn(
+                _pt0 = time.monotonic() if self._phases else 0.0
+                _args = (
                     f_in,
                     e_in,
                     self._frontier_count,
@@ -2466,6 +2509,12 @@ class XlaChecker(Checker):
                     self._disc_found,
                     self._disc_fp,
                 )
+                _pt1 = time.monotonic() if self._phases else 0.0
+                out = fn(*_args)
+                if self._phases:
+                    _pt2 = time.monotonic()
+                    self._jax.block_until_ready(out)
+                    _pt3 = time.monotonic()
                 (
                     nf,
                     ne,
@@ -2488,7 +2537,14 @@ class XlaChecker(Checker):
                 # syncs the commit logic pays anyway.
                 committed = not (bool(t_ovf) or bool(f_ovf) or bool(cc_ovf))
                 _sp.set(committed=int(committed))
+                _pt4 = time.monotonic() if self._phases else 0.0
             self.dispatch_log.append((run_cap, int(committed)))
+            if self._phases:
+                self._log_phases(
+                    _sp, flavor="single", bucket=run_cap, fresh=fresh,
+                    committed=int(committed),
+                    stamps=(_pt0, _pt1, _pt2, _pt3, _pt4),
+                )
             if self._heartbeat is not None:
                 self._heartbeat.commit(
                     depth=self._depth, states=self._state_count
@@ -2544,6 +2600,32 @@ class XlaChecker(Checker):
             and self._state_count >= self._target_state_count
         ):
             self._target_reached = True
+
+    #: Phase names in stamp order — the profiler's contiguous split of
+    #: one dispatch round-trip (docs/observability.md).
+    PHASE_NAMES = ("host_prep", "enqueue", "device_compute", "readback")
+
+    def _log_phases(
+        self, sp, *, flavor: str, bucket: int, fresh: bool,
+        committed: int, stamps: Tuple[float, ...],
+    ) -> None:
+        """Record one device call's phase split: a ``phase_log`` row
+        (dispatch_log-adjacent telemetry) plus four ``phase:*`` sub-spans
+        parented to the just-closed dispatch span. Called only with the
+        profiler on; the stamps are contiguous, so the phases partition
+        the dispatch span's interior exactly."""
+        row: Dict[str, Any] = {
+            "bucket": bucket, "flavor": flavor, "compile": fresh,
+            "committed": committed,
+        }
+        for i, name in enumerate(self.PHASE_NAMES):
+            dur = stamps[i + 1] - stamps[i]
+            row[name] = dur
+            self._tracer.emit(
+                f"phase:{name}", t0=stamps[i], dur=dur,
+                attrs={"bucket": bucket}, parent_id=sp.span_id,
+            )
+        self.phase_log.append(row)
 
     def _confirm_hv_candidates(self, hv_words, hv_fps, hv_counts) -> None:
         """Exact host-side re-check of device-flagged candidate states for

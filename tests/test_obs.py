@@ -36,7 +36,12 @@ def _spawn(**kw):
     return MODEL.checker().spawn_xla(**merged)
 
 #: The span-line schema (exactly these keys, docs/observability.md).
-SPAN_KEYS = {"ts", "dur", "name", "attrs"}
+#: ``span_id`` joined the pin in the distributed-tracing round; records
+#: from a tracer carrying a trace CONTEXT additionally hold
+#: ``trace_id``/``parent_id`` (CTX_SPAN_KEYS) — absent otherwise, so
+#: context-less traces stay byte-compatible with older consumers.
+SPAN_KEYS = {"ts", "dur", "name", "span_id", "attrs"}
+CTX_SPAN_KEYS = SPAN_KEYS | {"trace_id", "parent_id"}
 #: Attributes every dispatch span carries.
 DISPATCH_ATTRS = {
     "flavor", "bucket", "cand", "committed", "compile", "retry",
@@ -82,11 +87,13 @@ def test_span_jsonl_schema(tmp_path):
     lines = _spans(trace)
     assert lines, "trace is empty"
     for rec in lines:
-        assert set(rec) == SPAN_KEYS, rec
+        assert set(rec) == SPAN_KEYS, rec  # no ctx set -> no ctx keys
         assert isinstance(rec["ts"], (int, float)) and rec["ts"] >= 0
         assert isinstance(rec["dur"], (int, float)) and rec["dur"] >= 0
         assert isinstance(rec["name"], str)
+        assert isinstance(rec["span_id"], str)
         assert isinstance(rec["attrs"], dict)
+    assert len({r["span_id"] for r in lines}) == len(lines)
     assert lines[0]["name"] == "trace_start"
     assert {"pid", "unix_ts"} <= set(lines[0]["attrs"])
     disp = [r for r in lines if r["name"] == "dispatch"]
@@ -125,11 +132,14 @@ def test_chrome_export_valid(tmp_path):
     assert len(events) == n
     for ev in events:
         # The Chrome trace-event contract Perfetto loads: complete ("X")
-        # events with microsecond ts/dur and pid/tid lanes.
-        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"} <= set(ev)
-        assert ev["ph"] == "X"
+        # events with microsecond ts/dur and pid/tid lanes, plus "C"
+        # counter samples for spans carrying mux-lane telemetry.
+        assert ev["ph"] in ("X", "C")
+        if ev["ph"] == "X":
+            assert {"name", "cat", "ph", "ts", "dur", "pid", "tid",
+                    "args"} <= set(ev)
+            assert isinstance(ev["dur"], (int, float))
         assert isinstance(ev["ts"], (int, float))
-        assert isinstance(ev["dur"], (int, float))
         assert isinstance(ev["args"], dict)
 
 
@@ -142,6 +152,126 @@ def test_chrome_env_knob_exports_on_close(tmp_path, monkeypatch):
     c._tracer.close()  # atexit does this in real runs
     with open(chrome) as fh:
         assert json.load(fh)["traceEvents"]
+
+
+def test_chrome_mux_lane_counter_track():
+    """A span carrying ``lanes_active`` renders as a Perfetto counter
+    track ("C" event, lanes_active + derived lanes_idle) next to its
+    slice — the mux lane-occupancy chart."""
+    from stateright_tpu.obs.trace import chrome_events
+
+    rec = {"ts": 1.5, "dur": 0.25, "name": "dispatch", "span_id": "a.1",
+           "attrs": {"flavor": "mux", "lanes": 4, "lanes_active": 3}}
+    evs = chrome_events(rec, pid=7, tid=2)
+    assert [e["ph"] for e in evs] == ["X", "C"]
+    slice_, counter = evs
+    assert slice_["ts"] == counter["ts"] == 1.5e6
+    assert counter["name"] == "mux lanes"
+    assert counter["args"] == {"lanes_active": 3, "lanes_idle": 1}
+    # Context ids ride in the slice's args when present.
+    rec2 = dict(rec, trace_id="t" * 16, parent_id="a.0")
+    args = chrome_events(rec2, pid=7, tid=2)[0]["args"]
+    assert args["trace_id"] == "t" * 16 and args["parent_id"] == "a.0"
+
+
+# --- distributed tracing (docs/observability.md "Distributed tracing") ----
+
+
+def test_trace_ctx_env_inheritance(tmp_path, monkeypatch):
+    """STPU_TRACE_CTX is the cross-process seam: a tracer constructed
+    under it stamps every record with the trace id and defaults parents
+    to the context's span — engine spans in a worker join the
+    submission's trace with zero engine changes."""
+    from stateright_tpu.obs import trace as trace_mod
+
+    tid = trace_mod.new_trace_id()
+    assert len(tid) == 16
+    monkeypatch.setenv(trace_mod.CTX_ENV, trace_mod.format_ctx(tid, "p.9"))
+    trace = str(tmp_path / "trace.jsonl")
+    c = _spawn(trace=trace).join()
+    assert c._tracer.trace_id == tid
+    lines = _spans(trace)
+    for rec in lines:
+        assert SPAN_KEYS <= set(rec) <= CTX_SPAN_KEYS, rec
+        assert rec["trace_id"] == tid
+        assert rec["parent_id"] == "p.9"
+    # Malformed ctx degrades to context-less tracing, not a failure.
+    assert trace_mod.parse_ctx(":") is None
+    assert trace_mod.parse_ctx("") is None
+    assert trace_mod.parse_ctx("abc") == ("abc", None)
+
+
+def test_tracer_emit_overrides_and_preallocated_ids(tmp_path):
+    """Tracer.emit's per-record overrides: a shared tracer (one service
+    file, many jobs) stamps per-job trace ids without mutating ambient
+    state, and new_span_id pre-allocates so children can reference a
+    span emitted after they finish (the attempt span)."""
+    from stateright_tpu.obs.trace import Tracer
+
+    path = str(tmp_path / "t.jsonl")
+    tr = Tracer(path)
+    pre = tr.new_span_id()
+    child = tr.emit("child", t0=0.0, dur=0.1, parent_id=pre,
+                    trace_id="aaaa", attrs={"k": 1})
+    got = tr.emit("parent", t0=0.0, dur=0.2, trace_id="bbbb", span_id=pre)
+    assert got == pre and child != pre
+    tr.emit("ambient", t0=0.0, dur=0.0)
+    tr.close()
+    recs = {r["name"]: r for r in _spans(path)}
+    assert recs["child"]["trace_id"] == "aaaa"
+    assert recs["child"]["parent_id"] == pre
+    assert recs["parent"]["trace_id"] == "bbbb"
+    assert recs["parent"]["span_id"] == pre
+    assert "trace_id" not in recs["ambient"]  # no ambient ctx set
+
+
+# --- dispatch-phase profiler ----------------------------------------------
+
+
+def test_phases_profiler_rows_and_spans(tmp_path):
+    """``spawn_xla(phases=True)``: every device call logs a phase_log
+    row whose host_prep/enqueue/device_compute/readback partition the
+    parent dispatch span, and emits ``phase:*`` sub-spans parented to
+    the dispatch span's id (tools/roofline.py --phases consumes both)."""
+    trace = str(tmp_path / "trace.jsonl")
+    c = _spawn(trace=trace, phases=True).join()
+    assert c.unique_state_count() == 288
+    rows = c.phase_log
+    assert len(rows) == len(c.dispatch_log)
+    for row in rows:
+        assert {"bucket", "flavor", "compile", "committed"} <= set(row)
+        assert all(row[k] >= 0 for k in c.PHASE_NAMES)
+    lines = _spans(trace)
+    disp = {r["span_id"]: r for r in lines if r["name"] == "dispatch"}
+    phase = [r for r in lines if r["name"].startswith("phase:")]
+    assert len(phase) == len(rows) * len(c.PHASE_NAMES)
+    for rec in phase:
+        assert rec["parent_id"] in disp, rec
+    # Phases partition their dispatch: the four sub-spans sum to the
+    # parent's wall-clock minus only the inter-stamp bookkeeping.
+    by_parent = {}
+    for rec in phase:
+        by_parent.setdefault(rec["parent_id"], 0.0)
+        by_parent[rec["parent_id"]] += rec["dur"]
+    for sid, total in by_parent.items():
+        assert 0.0 <= disp[sid]["dur"] - total < 0.05, (sid, total)
+
+
+def test_phases_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("STPU_TRACE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("STPU_PHASES", "1")
+    c = _spawn().join()
+    assert c._phases and len(c.phase_log) == len(c.dispatch_log)
+    with pytest.raises(ValueError, match="STPU_PHASES"):
+        monkeypatch.setenv("STPU_PHASES", "maybe")
+        _spawn()
+
+
+def test_phases_require_tracer():
+    """phases=True without a trace sink is inert (nowhere to emit the
+    sub-spans), not an error — the flag gates on tracer.enabled."""
+    c = _spawn(phases=True).join()
+    assert not c._phases and c.phase_log == []
 
 
 # --- heartbeat ------------------------------------------------------------
@@ -455,13 +585,17 @@ def test_tracing_off_is_nulled_and_bit_identical(tmp_path):
     assert off._tracer is NULL_TRACER
     assert off._heartbeat is None
     assert off._recorder is None
+    # The dispatch-phase profiler shares the pin: off by default, no
+    # clock stamps, no rows.
+    assert off._phases is False and off.phase_log == []
 
     trace = str(tmp_path / "trace.jsonl")
     hb = str(tmp_path / "hb.json")
     on = _spawn(
-        trace=trace, heartbeat=hb,
+        trace=trace, heartbeat=hb, phases=True,
         metrics_to=str(tmp_path / "metrics.jsonl"), metrics_every=1,
     ).join()
+    assert len(on.phase_log) == len(on.dispatch_log) > 0
     # Engine results are bit-identical with tracing on: same counts, same
     # schedule, same per-level telemetry (spans only *observe* host
     # boundaries; they never change what runs on the device).

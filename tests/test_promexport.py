@@ -73,6 +73,23 @@ def test_render_parse_round_trip():
         assert parsed[(name, frozenset(labels.items()))] == pytest.approx(value)
 
 
+def test_build_info_sample_renders_and_memoizes():
+    """The stpu_build_info gauge: value 1, arbitrary label keys render
+    through the OpenMetrics writer, and the expensive labels (jax
+    version + package tree hash) compute once per process."""
+    s1 = pe.build_info_sample(platform="tpu")
+    name, labels, value = s1
+    assert name == "stpu_build_info" and value == 1.0
+    assert labels["platform"] == "tpu"
+    assert {"jax", "tree"} <= set(labels)
+    parsed = pe.parse_openmetrics(pe.render_openmetrics([s1]))
+    assert parsed[(name, frozenset(labels.items()))] == 1.0
+    s2 = pe.build_info_sample(platform="cpu")
+    # Same memoized identity labels, only the platform differs.
+    assert {k: v for k, v in s2[1].items() if k != "platform"} == \
+        {k: v for k, v in labels.items() if k != "platform"}
+
+
 def test_parser_rejects_malformed():
     ok = pe.render_openmetrics([("stpu_depth", {"engine": "xla"}, 4.0)])
     # Missing terminator.
@@ -186,6 +203,20 @@ def test_smoke_metrics_endpoint(tmp_path):
             # interactive slot).
             assert parsed[("stpu_pool_interactive", frozenset())] == 1
             assert parsed[("stpu_pool_breaker_open", frozenset())] == 0
+            # Build-info gauge: value 1, platform/jax/tree labels (the
+            # tree hash ties a scrape to the package the lint cache
+            # keyed — which code produced these numbers).
+            build = [
+                (labels, v) for (fam, labels), v in parsed.items()
+                if fam == "stpu_build_info"
+            ]
+            assert len(build) == 1
+            labels, v = build[0]
+            assert v == 1
+            keys = dict(labels)
+            assert {"platform", "jax", "tree"} <= set(keys)
+            assert keys["platform"] == "cpu"
+            assert len(keys["tree"]) == 12
             # The windowed per-job series endpoint serves the live ring.
             code, body = app.job_metrics(job_id, window=16)
             assert code == 200

@@ -578,7 +578,7 @@ def test_fleet_replay_folds_routes_and_migrations():
     assert state["next_id"] == 2
     assert state["routes"]["fjob-0001"] == {
         "device": 1, "pool_job": "job-0002", "spec": "2pc:3",
-        "idempotency_key": "k1",
+        "idempotency_key": "k1", "trace_id": None,
     }
     assert state["routes"]["fjob-0002"]["device"] == 1
     assert state["idem"] == {"k1": "fjob-0001"}
@@ -719,6 +719,109 @@ def test_fleet_restart_reroutes_orphans_from_journaled_spec(tmp_path):
         assert fjob.done and "migration failed" in fjob.error
     finally:
         f3.close()
+
+
+# --- distributed-trace continuity (docs/observability.md) -------------------
+
+
+def test_trace_id_minted_journaled_and_restored(tmp_path):
+    """Every submission mints a trace id — tracer on or off — and the
+    journal carries it ('submitted'/'started'): a restart restores the
+    SAME id, so spans from pre- and post-crash attempts stitch into one
+    trace; idempotent resubmission keeps it too."""
+    svc = _disarmed(tmp_path)
+    job = svc.submit("2pc:3", idempotency_key="t1", max_seconds=120.0)
+    tid = job.trace_id
+    assert tid and len(tid) == 16
+    assert job.snapshot()["trace_id"] == tid
+    with svc._cond:
+        job.status = "running"
+        svc._jlog("started", job=job.id, attempt=0, engine="xla",
+                  resumed_from=None, pid=None, trace_id=job.trace_id)
+    svc.close()
+
+    svc2 = _disarmed(tmp_path)
+    try:
+        assert svc2.job(job.id).trace_id == tid
+        again = svc2.submit("2pc:3", idempotency_key="t1")
+        assert again.trace_id == tid
+    finally:
+        svc2.close()
+
+
+def test_replay_state_folds_trace_id():
+    """'submitted' carries the trace id; a later 'started' (a migration
+    resubmit journals it there too) refreshes it; journals from before
+    the tracing round replay with trace_id None, not a KeyError."""
+    records = []
+
+    def rec(event, **kw):
+        r = {"v": 1, "seq": len(records) + 1, "event": event, **kw}
+        records.append(r)
+        return r
+
+    rec("submitted", ts=1.0, job="job-0001", spec="2pc:3",
+        max_seconds=60.0, dir="s/job-0001", trace_id="aa" * 8)
+    rec("submitted", ts=1.5, job="job-0002", spec="2pc:3",
+        max_seconds=60.0, dir="s/job-0002")  # pre-tracing record shape
+    rec("started", ts=2.0, job="job-0002", attempt=0, engine="xla",
+        pid=999, trace_id="bb" * 8)
+    state = _replay_state(records)
+    assert state["jobs"]["job-0001"]["trace_id"] == "aa" * 8
+    assert state["jobs"]["job-0002"]["trace_id"] == "bb" * 8
+
+
+def test_fleet_trace_id_spans_routing_and_restart(tmp_path):
+    """The fleet mints the trace id; the routed pool job JOINS it (one
+    id across the fleet→pool hop), fleet.jsonl journals it, and a
+    full-fleet restart restores it on both tiers."""
+    f1 = _fleet_disarmed(tmp_path)
+    a = f1.submit("2pc:3", idempotency_key="ft")
+    tid = a.trace_id
+    assert tid and a.pool_job.trace_id == tid
+    assert a.snapshot()["trace_id"] == tid
+    f1.close()
+
+    f2 = _fleet_disarmed(tmp_path)
+    try:
+        fjob = f2.job(a.id)
+        assert fjob.trace_id == tid
+        assert fjob.pool_job.trace_id == tid
+    finally:
+        f2.close()
+
+
+def test_fleet_migration_keeps_trace_id(tmp_path):
+    """A migrated job's new attempt on the sibling device continues the
+    ORIGINAL trace: the straggler repair resubmits with the journaled
+    trace id, so the post-migration spans stitch to the pre-loss ones."""
+
+    def reopen(interval):
+        return FleetService(FleetConfig(
+            run_dir=str(tmp_path / "fleet"),
+            devices=2,
+            monitor_interval_s=interval,
+            pool=_config(tmp_path, max_inflight=0),
+        ))
+
+    f1 = reopen(60.0)
+    a = f1.submit("2pc:3", idempotency_key="mt")
+    tid = a.trace_id
+    victim = a.device
+    f1.close()
+    os.remove(os.path.join(
+        str(tmp_path / "fleet"), f"device-{victim}", "journal.jsonl"
+    ))
+
+    f2 = reopen(60.0)
+    try:
+        fjob = f2.job(a.id)
+        assert fjob.trace_id == tid  # restored from fleet.jsonl's route
+        assert f2._migrate_stragglers() == 1
+        assert fjob.pool_job is not None
+        assert fjob.pool_job.trace_id == tid
+    finally:
+        f2.close()
 
 
 def test_fleet_pools_export_chaos_to_workers(tmp_path):

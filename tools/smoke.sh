@@ -22,7 +22,11 @@
 # co-queued same-spec jobs as ONE worker.py --mux invocation — exact
 # pinned counts per member, per-lane mux provenance, pool gauges,
 # journaled mux_group starts — the batched-scheduling tier's tier-0
-# proof).
+# proof), and the <30s TRACE MERGE drill (a phases-profiled packed model
+# plus a traced 2-job service round merge via obs/collect.py into one
+# Chrome trace: schema valid, monotonic timeline, flow arrows resolve,
+# phases partition their dispatch — the distributed-tracing tier's
+# tier-0 proof).
 # A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
@@ -53,4 +57,5 @@ exec timeout -k 10 480 python -m pytest \
   tests/test_service.py::test_smoke_fleet_failover \
   tests/test_service_durability.py::test_smoke_service_restart_resume \
   tests/test_mux.py::test_smoke_mux \
+  tests/test_trace_collect.py::test_smoke_trace_merge \
   -x -q -p no:cacheprovider "$@"
