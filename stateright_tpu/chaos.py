@@ -51,6 +51,15 @@ Injection points (the seams; each is one hook call in the named owner):
 - ``lint.timeout`` — ``CheckerService._admission_verdict``: simulate the
   admission-lint subprocess timing out (the fail-open tooling-error
   path, counted as ``lint_errors``).
+- ``tenant.storm`` — consumed by ``tools/service_chaos.py``'s serve
+  loop: on the N-th scheduled submission, burst ``rate`` (default 5)
+  extra same-tenant submissions (params ``tenant`` = tenant id, default
+  ``storm``; ``class`` = priority class, default ``best_effort``;
+  ``rate`` = burst size) through the live service — the admission storm
+  the QoS tier (docs/service.md "QoS & overload") must shed typed,
+  hint-accurately, without starving the admitted set. Deterministic
+  idempotency keys (``storm-<seed>-<i>``) make a restarted incarnation's
+  re-fired storm dedupe instead of double-submitting.
 - ``device.lost`` / ``device.flaky`` — consumed by
   ``FleetService.submit`` (``service/fleet.py``). ``device.lost``
   (params ``device`` = target index, default the device just routed to;

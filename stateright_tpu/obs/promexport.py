@@ -14,7 +14,9 @@ mandatory ``_total`` suffix; everything else numeric is a *gauge*;
 booleans render 0/1; strings and None are skipped (they ride as labels or
 not at all). Labels carried per sample: ``job`` (the pool job id),
 ``engine``, ``dedup`` — the identity triple the ISSUE pins — with absent
-values omitted, never empty-stringed.
+values omitted, never empty-stringed. QoS rollups (``gauges()["qos"]``)
+additionally ride ``class=`` / ``tenant=`` labels on the
+``stpu_pool_qos_*`` families (docs/service.md "QoS & overload").
 
 The module also ships :func:`parse_openmetrics` — a strict-enough parser
 (TYPE tracking, label unescaping, the ``# EOF`` terminator) used by the
@@ -69,6 +71,13 @@ COUNTER_KEYS = frozenset(
         "orphans_killed",
         "artifacts_swept",
         "jobs_evacuated",
+        # QoS tier (docs/service.md "QoS & overload"): shed admissions,
+        # tenant-quota rejections, aging-term scheduler picks, and
+        # compile-on-admit warm-cache spawns.
+        "sheds",
+        "quota_rejects",
+        "aged_picks",
+        "warm_compiles",
         # batched scheduling (xla_mux.py; docs/service.md "Batched
         # scheduling") — mux_groups/mux_lanes count groups/members the
         # pool launched, mux_dispatches_saved the device calls the
@@ -84,6 +93,8 @@ COUNTER_KEYS = frozenset(
         "devices_lost",
         "device_flakes",
         "host_last_resort",
+        "pools_quiesced",
+        "pools_woken",
     }
 )
 
@@ -170,6 +181,9 @@ def pool_samples(
             if v is not None:
                 out.append((f"{prefix}_journal_records_total", lab, v))
             continue
+        if key == "qos" and isinstance(value, dict):
+            out.extend(_qos_samples(value, lab, prefix))
+            continue
         v = _numeric(value)
         if v is None:
             continue
@@ -177,6 +191,42 @@ def pool_samples(
             f"{prefix}_{key}_total" if key in COUNTER_KEYS else f"{prefix}_{key}"
         )
         out.append((name, lab, v))
+    return out
+
+
+def _qos_samples(
+    qos: Dict[str, Any], lab: Dict[str, str], prefix: str
+) -> List[Sample]:
+    """Flatten a ``gauges()["qos"]`` dict (docs/service.md "QoS &
+    overload"): per-class rows render under ``{prefix}_qos_class_*`` with
+    a ``class`` label, per-tenant rows under ``{prefix}_qos_tenant_*``
+    with a ``tenant`` label, scalar fields (``aging_s``,
+    ``drain_per_s``) as plain gauges. ``served`` is the journaled
+    monotonic stride counter, so it renders as an OpenMetrics counter."""
+    out: List[Sample] = []
+    for key, value in qos.items():
+        if key in ("classes", "tenants") and isinstance(value, dict):
+            label_key = "class" if key == "classes" else "tenant"
+            suffix = "class" if key == "classes" else "tenant"
+            for ident, row in value.items():
+                if not isinstance(row, dict):
+                    continue
+                row_lab = dict(lab)
+                row_lab[label_key] = str(ident)
+                for f, fv in row.items():
+                    v = _numeric(fv)
+                    if v is None:
+                        continue
+                    name = (
+                        f"{prefix}_qos_{suffix}_{f}_total"
+                        if f == "served"
+                        else f"{prefix}_qos_{suffix}_{f}"
+                    )
+                    out.append((name, row_lab, v))
+            continue
+        v = _numeric(value)
+        if v is not None:
+            out.append((f"{prefix}_qos_{key}", lab, v))
     return out
 
 

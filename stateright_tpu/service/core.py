@@ -68,6 +68,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -110,7 +111,32 @@ SERVICE_COUNTERS = (
     "mux_groups",
     "mux_lanes",
     "mux_dispatches_saved",
+    "sheds",
+    "quota_rejects",
+    "aged_picks",
+    "warm_compiles",
 )
+
+#: Priority classes, highest first (docs/service.md "QoS & overload").
+#: ``interactive`` here is a *batch-job* urgency class (latency-sensitive
+#: checking requests), distinct from ``Job.kind == "interactive"`` (live
+#: Explorer sessions, which bypass the batch queue entirely).
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+#: Default fair-share weights: an interactive job earns device slots at
+#: 4x a best-effort job's rate, batch at 2x. Override per pool with
+#: ``ServiceConfig(class_weights=)``.
+DEFAULT_CLASS_WEIGHTS = {"interactive": 4.0, "batch": 2.0, "best_effort": 1.0}
+
+#: Default overload-shedding thresholds: the fraction of ``max_queue``
+#: occupancy above which a class is shed at admission. Best-effort sheds
+#: at half-full, batch at three-quarters, interactive only at the hard
+#: queue cap — graceful degradation drops the least-important work first.
+DEFAULT_SHED_THRESHOLDS = {
+    "interactive": 1.0,
+    "batch": 0.75,
+    "best_effort": 0.5,
+}
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "worker.py")
 #: The admission flight-check entry point (stpu-lint's --admission mode;
@@ -121,6 +147,15 @@ _LINT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "tools",
     "stpu_lint.py",
+)
+#: Compile-on-admit cache warmer (tools/warm_cache.py): a user family's
+#: first admission pre-banks its (bucket, rung) compile-plan shapes into
+#: the shared .jax_cache in a background subprocess, so the tenant's
+#: first real job never pays cold XLA compiles inside its budget.
+_WARM = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "tools",
+    "warm_cache.py",
 )
 
 
@@ -178,6 +213,41 @@ class ServiceConfig:
     #: submissions of the same spec free.
     admission_lint: bool = True
     lint_timeout_s: float = 240.0
+    # -- QoS & overload (docs/service.md "QoS & overload") -----------------
+    #: Per-class fair-share weights (class -> weight); keys beyond the
+    #: defaults are merged over ``DEFAULT_CLASS_WEIGHTS`` at
+    #: construction. A class's share of device slots under contention is
+    #: weight / sum(weights of backlogged classes).
+    class_weights: Optional[Dict[str, float]] = None
+    #: The aging time constant: a queued job's effective priority
+    #: ``w_class + waited_s / qos_aging_s`` rises monotonically, and the
+    #: job jumps the fair-share queue entirely ("aged") once
+    #: ``waited_s >= qos_aging_s * (w_max + 1 - w_class)`` — THE
+    #: documented starvation bound (defaults: best_effort 2400 s,
+    #: batch 1800 s).
+    qos_aging_s: float = 600.0
+    #: Per-class shed thresholds (fraction of ``max_queue`` occupancy
+    #: above which the class is rejected at admission); merged over
+    #: ``DEFAULT_SHED_THRESHOLDS``.
+    shed_thresholds: Optional[Dict[str, float]] = None
+    #: Per-tenant quotas, enforced at admission (queued) and scheduling
+    #: (in-flight): defaults for every tenant, overridable per tenant id
+    #: via ``tenant_quotas={"t1": {"max_queued": 2, ...}}``. None = no
+    #: limit.
+    tenant_max_queued: Optional[int] = None
+    tenant_max_inflight: Optional[int] = None
+    #: Device-seconds budget per tenant: a submission whose requested
+    #: ``max_seconds`` would push the tenant's lifetime charged + asked
+    #: wall-clock over this rejects typed (``quota_rejects``).
+    tenant_budget_s: Optional[float] = None
+    tenant_quotas: Optional[Dict[str, Dict[str, Any]]] = None
+    #: Completion-rate window for the measured drain rate behind
+    #: ``Retry-After`` hints (docs/service.md "QoS & overload").
+    drain_window_s: float = 300.0
+    #: Compile-on-admit: warm a user family's (STPU_FAMILIES) compile
+    #: plan into .jax_cache via tools/warm_cache.py in a background
+    #: subprocess on its first admission (counter ``warm_compiles``).
+    warm_user_families: bool = True
     # -- workers -----------------------------------------------------------
     platform: str = "default"  #: "default" (accelerator) | "cpu" (tests)
     compile_cache: Optional[str] = None  #: default: <cwd>/.jax_cache
@@ -263,12 +333,23 @@ class Job:
         max_states: Optional[int] = None,
         chaos: Optional[Dict[str, Any]] = None,
         idempotency_key: Optional[str] = None,
+        tenant: str = "default",
+        priority: str = "batch",
+        deadline_s: Optional[float] = None,
     ):
         self._service = service
         self.id = job_id
         self.spec = spec
         self.kind = kind  #: "batch" | "interactive"
         self.idempotency_key = idempotency_key
+        #: QoS identity (docs/service.md "QoS & overload"): the
+        #: submitting tenant, the priority class (PRIORITY_CLASSES), and
+        #: an optional soft deadline — EDF orders same-class picks by
+        #: ``created_unix_ts + deadline_s``. All three ride the journal's
+        #: ``submitted`` record so a restart replays scheduler state.
+        self.tenant = tenant
+        self.priority = priority
+        self.deadline_s = deadline_s
         #: queued|running|quarantined|done|failed|migrated — "migrated" is
         #: terminal FOR THIS POOL: the fleet evacuated the job to a
         #: sibling device (service/fleet.py), which owns it from then on.
@@ -385,6 +466,9 @@ class Job:
             "status": self.status,
             "engine": self.engine,
             "degraded": self.degraded,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "deadline_s": self.deadline_s,
             # The device this pool serves (fleet pools; None on the
             # single-device pool) — the dashboard's per-device grouping.
             "device": self._service._cfg.device,
@@ -461,6 +545,9 @@ class Job:
             "created_unix_ts": self.created_unix_ts,
             "completed_unix_ts": self.completed_unix_ts,
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "deadline_s": self.deadline_s,
         }
 
     def metrics(self) -> Optional[Dict[str, Any]]:
@@ -506,6 +593,11 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "jobs": {},
         "order": [],
         "last_ts": 0.0,
+        # Fair-share scheduler state (docs/service.md "QoS & overload"):
+        # per-class served counts — the stride scheduler's pass values
+        # derive as served/weight, so a restart resumes the SAME
+        # inter-class rotation instead of resetting every class's credit.
+        "qos_served": {},
     }
 
     def counters_inc(name: str, n: int = 1) -> None:
@@ -529,6 +621,7 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 j for j in s.get("order", list(state["jobs"]))
                 if j in state["jobs"]
             ]
+            state["qos_served"] = dict(s.get("qos_served", {}))
             continue
         if ev == "recovered":
             continue
@@ -571,6 +664,12 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "created_unix_ts": rec["ts"],
                 "completed_unix_ts": None,
                 "trace_id": rec.get("trace_id"),
+                # QoS identity; .get defaults keep pre-QoS journals
+                # replaying (every old job reads as a default-tenant
+                # batch-class submission, exactly its old behavior).
+                "tenant": rec.get("tenant", "default"),
+                "priority": rec.get("priority", "batch"),
+                "deadline_s": rec.get("deadline_s"),
             }
             state["jobs"][jid] = job
             state["order"].append(jid)
@@ -597,6 +696,11 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             job["status"] = "running"
             job["started_ts"] = rec["ts"]
             job["pid"] = rec.get("pid")
+            # Each start is one fair-share pick: re-derive the stride
+            # scheduler's per-class served counts from the events after
+            # the last snapshot (the snapshot carries the base).
+            cls = job.get("priority", "batch")
+            state["qos_served"][cls] = state["qos_served"].get(cls, 0) + 1
             job["engine"] = rec.get("engine", job["engine"])
             job["degraded"] = job["degraded"] or job["engine"] == "host"
             # Older journals only carried the trace id on `submitted`;
@@ -662,6 +766,31 @@ class CheckerService:
         self._cfg = config or ServiceConfig(**overrides)
         if self._cfg.compile_cache is None:
             self._cfg.compile_cache = os.path.abspath(".jax_cache")
+        # QoS knob normalization (docs/service.md "QoS & overload"):
+        # partial dicts merge over the defaults so a pool can reweight
+        # one class without restating the rest.
+        self._class_weights = dict(
+            DEFAULT_CLASS_WEIGHTS, **(self._cfg.class_weights or {})
+        )
+        self._shed_thresholds = dict(
+            DEFAULT_SHED_THRESHOLDS, **(self._cfg.shed_thresholds or {})
+        )
+        self._w_max = max(self._class_weights.values())
+        #: Stride fair-share state: per-class picks served (journaled in
+        #: the compaction snapshot, re-derived from `started` events on
+        #: replay) and a live-only pass floor that forfeits the credit a
+        #: class accrued while it had nothing queued (an idle class must
+        #: not bank an unbounded burst against its siblings).
+        self._qos_served: Dict[str, int] = {}
+        self._qos_floor: Dict[str, float] = {}
+        #: Completion timeline for the measured drain rate behind
+        #: Retry-After: (unix_ts, priority) per settled batch job,
+        #: trimmed to drain_window_s; seeded at replay from restored
+        #: jobs' completed_unix_ts.
+        self._drain: deque = deque()
+        #: Compile-on-admit memo (family -> True): one background
+        #: warm_cache subprocess per user family per service lifetime.
+        self._warm_started: Dict[str, bool] = {}
         if self._cfg.mux_k is None:
             try:
                 self._cfg.mux_k = max(1, int(os.environ.get("STPU_MUX", "1")))
@@ -829,6 +958,7 @@ class CheckerService:
             "breaker_opened_unix_ts": self._breaker_opened_unix_ts,
             "counters": self._counters.snapshot(),
             "idem": dict(self._idem),
+            "qos_served": dict(self._qos_served),
             "order": [
                 jid for jid in self._order
                 if self._jobs[jid].kind == "batch"
@@ -861,6 +991,8 @@ class CheckerService:
             self._consecutive_wedges = state["consecutive_wedges"]
             self._breaker_opened_unix_ts = state["breaker_opened_unix_ts"]
             self._idem.update(state["idem"])
+            for cls, served in state["qos_served"].items():
+                self._qos_served[cls] = self._qos_served.get(cls, 0) + served
             for name, value in state["counters"].items():
                 # jobs_recovered/orphans_killed are per-INCARNATION (they
                 # mirror the recovery provenance dict); restoring them
@@ -879,6 +1011,9 @@ class CheckerService:
                     max_states=rec.get("max_states"),
                     chaos=rec.get("chaos"),
                     idempotency_key=rec.get("idempotency_key"),
+                    tenant=rec.get("tenant", "default"),
+                    priority=rec.get("priority", "batch"),
+                    deadline_s=rec.get("deadline_s"),
                 )
                 job.recovered = True
                 job.created_unix_ts = rec.get("created_unix_ts", now)
@@ -910,6 +1045,18 @@ class CheckerService:
                     job.status = status
                     job.completed_unix_ts = rec.get("completed_unix_ts")
                     job.result = rec.get("result")
+                    if (
+                        status in ("done", "failed")
+                        and job.completed_unix_ts is not None
+                        and now - job.completed_unix_ts
+                        <= self._cfg.drain_window_s
+                    ):
+                        # Seed the measured drain rate: completions the
+                        # dead incarnation settled inside the window
+                        # still count toward Retry-After accuracy.
+                        self._drain.append(
+                            (job.completed_unix_ts, job.priority)
+                        )
                     result_path = (
                         os.path.join(job.dir, "result.json")
                         if job.dir is not None
@@ -961,6 +1108,10 @@ class CheckerService:
                 self._jobs[jid] = job
                 self._order.append(jid)
                 self._counters.inc("jobs_recovered")
+            # The replay walks submission order; completions may have
+            # settled in any order — the drain window trims from the
+            # left, so keep it time-sorted.
+            self._drain = deque(sorted(self._drain))
         killed = 0
         for pid, job in orphans:
             if self._kill_orphan(pid, job):
@@ -1078,17 +1229,140 @@ class CheckerService:
             c[j.status] += 1
         return c
 
-    def _retry_after(self, counts: Dict[str, int]) -> float:
-        """The back-pressure estimate: jobs ahead, amortized over the
-        in-flight slots at the default budget. An estimate, not a promise
-        — but monotone in pool pressure, which is what a client's retry
-        loop needs."""
-        ahead = counts["queued"] + counts["quarantined"] + counts["running"]
+    def _record_drain(self, priority: str) -> None:
+        """One settled batch job on the completion timeline (caller holds
+        the lock) — the measured drain rate behind ``Retry-After``."""
+        now = time.time()
+        self._drain.append((now, priority))
+        cutoff = now - self._cfg.drain_window_s
+        while self._drain and self._drain[0][0] < cutoff:
+            self._drain.popleft()
+
+    def _drain_rate(self, priority: Optional[str] = None) -> Optional[float]:
+        """Measured completions/second over ``drain_window_s`` (caller
+        holds the lock), optionally for one class; None below two
+        completions — one settlement is an anecdote, not a rate."""
+        now = time.time()
+        cutoff = now - self._cfg.drain_window_s
+        while self._drain and self._drain[0][0] < cutoff:
+            self._drain.popleft()
+        ts = [
+            t for t, cls in self._drain
+            if priority is None or cls == priority
+        ]
+        if len(ts) < 2:
+            return None
+        span = max(now - ts[0], 1e-3)
+        return len(ts) / span
+
+    def _jobs_ahead(self, priority: Optional[str]) -> int:
+        """How many batch jobs the scheduler would serve before (or
+        alongside) a NEW submission of ``priority`` — same-or-higher
+        class weight among the non-terminal set. Caller holds the
+        lock."""
+        w = (
+            self._class_weights.get(priority, 1.0)
+            if priority is not None
+            else 0.0
+        )
+        ahead = 0
+        for j in self._jobs.values():
+            if j.kind != "batch" or j.done:
+                continue
+            if (
+                priority is None
+                or self._class_weights.get(j.priority, 1.0) >= w
+            ):
+                ahead += 1
+        return ahead
+
+    def _retry_after(
+        self, counts: Dict[str, int], priority: Optional[str] = None
+    ) -> float:
+        """The back-pressure estimate an HTTP front end would send as
+        ``Retry-After``: jobs ahead of (same-or-higher class than) the
+        rejected submission over the MEASURED drain rate — the per-class
+        completion timeline when that class has recent settlements, the
+        pool-wide rate otherwise. Falls back to the static jobs-ahead /
+        slots * default-budget guess only when the window holds fewer
+        than two completions (a cold pool has no rate to measure). An
+        estimate, not a promise — but monotone in pool pressure, which
+        is what a client's retry loop needs."""
+        ahead = self._jobs_ahead(priority)
+        rate = self._drain_rate(priority) or self._drain_rate()
+        if rate is not None:
+            # +1: the retrier's own job must drain too.
+            return min(
+                max(5.0, (ahead + 1) / rate), self._cfg.max_seconds_cap
+            )
         per_slot = ahead / max(self._cfg.max_inflight, 1)
         return min(
             max(10.0, per_slot * self._cfg.default_max_seconds * 0.5),
             self._cfg.max_seconds_cap,
         )
+
+    def _tenant_quota(self, tenant: str) -> Dict[str, Any]:
+        """The effective quota for one tenant: per-tenant overrides
+        merged over the pool-wide defaults; None values = unlimited."""
+        quota = {
+            "max_queued": self._cfg.tenant_max_queued,
+            "max_inflight": self._cfg.tenant_max_inflight,
+            "budget_s": self._cfg.tenant_budget_s,
+        }
+        quota.update((self._cfg.tenant_quotas or {}).get(tenant, {}))
+        return quota
+
+    def _tenant_usage(self, tenant: str) -> Dict[str, float]:
+        """One tenant's live pool usage (caller holds the lock), derived
+        by scanning the job table — no separate books to drift or
+        replay: restored jobs ARE the quota state."""
+        queued = inflight = 0
+        spent = 0.0
+        for j in self._jobs.values():
+            if j.kind != "batch" or j.tenant != tenant:
+                continue
+            spent += j.consumed_s
+            if j.status in ("queued", "quarantined"):
+                queued += 1
+            elif j.status == "running":
+                inflight += 1
+        return {"queued": queued, "inflight": inflight, "spent_s": spent}
+
+    def _quota_rejection(
+        self, tenant: str, max_seconds: float
+    ) -> Optional[str]:
+        """The per-tenant admission verdict (caller holds the lock):
+        the rejection reason, or None when the tenant is inside its
+        quota. In-flight quota is enforced at SCHEDULING time (the
+        fair-share pick skips a saturated tenant), not here — a queued
+        job costs nothing until a slot serves it."""
+        quota = self._tenant_quota(tenant)
+        usage = self._tenant_usage(tenant)
+        if (
+            quota["max_queued"] is not None
+            and usage["queued"] >= quota["max_queued"]
+        ):
+            return (
+                f"tenant {tenant!r} queued quota reached "
+                f"({quota['max_queued']})"
+            )
+        if (
+            quota["budget_s"] is not None
+            and usage["spent_s"] + max_seconds > quota["budget_s"]
+        ):
+            return (
+                f"tenant {tenant!r} device-seconds budget exceeded "
+                f"({usage['spent_s']:.0f}s spent + {max_seconds:.0f}s "
+                f"asked > {quota['budget_s']:.0f}s)"
+            )
+        return None
+
+    def _shed_occupancy_limit(self, priority: str) -> int:
+        """The queue occupancy at which ``priority`` sheds: its
+        threshold fraction of ``max_queue``, floored at one so a
+        threshold never rejects an empty pool."""
+        frac = self._shed_thresholds.get(priority, 1.0)
+        return max(1, int(round(self._cfg.max_queue * frac)))
 
     def _budget_rejection(
         self, max_seconds: float, max_states: Optional[int]
@@ -1205,6 +1479,33 @@ class CheckerService:
                 waiter.set()
         return verdict
 
+    def _spawn_warm(self, family: str, spec: str) -> None:
+        """Fire-and-forget compile-on-admit warmer: one background
+        ``tools/warm_cache.py --specs <spec>`` subprocess per user
+        family per service lifetime, banking the family's STPU007
+        compile-plan shapes into the pool's shared compile cache. Best
+        effort by design — a warm failure costs the tenant only the
+        cold compile its first job would have paid anyway."""
+        out_dir = os.path.join(self._cfg.run_dir, "warm")
+        argv = [
+            sys.executable, _WARM,
+            "--specs", spec,
+            "--platform", self._cfg.platform,
+            "--cache-dir", self._cfg.compile_cache,
+            "--out-dir", out_dir,
+        ]
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(os.path.join(out_dir, f"{family}.log"), "ab") as fh:
+                subprocess.Popen(
+                    argv,
+                    stdout=fh,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+        except OSError as e:
+            self.log(f"compile-on-admit warm failed to spawn: {e}")
+
     def submit(
         self,
         spec: str,
@@ -1217,6 +1518,9 @@ class CheckerService:
         spent_s: float = 0.0,
         resume_from: Optional[str] = None,
         trace_id: Optional[str] = None,
+        tenant: str = "default",
+        priority: str = "batch",
+        deadline_s: Optional[float] = None,
     ) -> Job:
         """Queues one batch checking job; returns its :class:`Job` handle
         or raises :class:`AdmissionError` (queue full → carries
@@ -1245,10 +1549,28 @@ class CheckerService:
         ``trace_id`` joins an existing distributed trace (the fleet
         passes its minted id; migration passes the victim's) instead of
         minting a fresh one — docs/observability.md "Distributed
-        tracing"."""
+        tracing".
+
+        The QoS identity (docs/service.md "QoS & overload"): ``tenant``
+        names the submitter (quota accounting), ``priority`` picks the
+        class (:data:`PRIORITY_CLASSES` — weighted fair-share slots,
+        overload shedding order), ``deadline_s`` is a soft deadline from
+        submission that EDF-orders same-class picks. Under overload a
+        lower class sheds FIRST (typed, class-naming
+        :class:`AdmissionError` whose ``retry_after_s`` comes from the
+        measured per-class drain rate); a tenant over its queued/budget
+        quota rejects typed (``quota_rejects``)."""
         if engine not in ("auto", "host"):
             raise ValueError(f"engine must be 'auto' or 'host', got {engine!r}")
-        registry.parse(spec)  # typed spec validation, pre-admission
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority must be one of {PRIORITY_CLASSES}, got {priority!r}"
+            )
+        if not tenant or not isinstance(tenant, str):
+            raise ValueError(f"tenant must be a non-empty string, got {tenant!r}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s!r}")
+        family, _ = registry.parse(spec)  # typed spec validation, pre-admission
         _t0 = time.monotonic()
         with self._lock:
             # Pre-flight closed check: a closed pool must reject
@@ -1277,9 +1599,13 @@ class CheckerService:
         if budget_reason is None and self._cfg.admission_lint:
             with self._lock:
                 counts = self._counts()
+                # The class's SHED limit, not the hard cap: a
+                # best-effort submission a half-full pool is about to
+                # shed must not pay a cold lint subprocess either.
                 queue_full = (
                     counts["queued"] + counts["quarantined"]
-                    >= self._cfg.max_queue
+                    >= self._shed_occupancy_limit(priority)
+                    or self._quota_rejection(tenant, max_seconds) is not None
                 )
         # The flight-check runs OUTSIDE the lock (a cold check is a
         # subprocess); scheduling state is only touched afterwards.
@@ -1311,20 +1637,49 @@ class CheckerService:
             if budget_reason is not None:
                 self._counters.inc("rejected")
                 raise AdmissionError(budget_reason)
+            quota_reason = self._quota_rejection(tenant, max_seconds)
+            if quota_reason is not None:
+                self._counters.inc("rejected")
+                self._counters.inc("quota_rejects")
+                raise AdmissionError(
+                    quota_reason,
+                    # A queued-quota rejection clears as the tenant's
+                    # own jobs drain; a budget quota never does.
+                    retry_after_s=(
+                        self._retry_after(self._counts(), priority)
+                        if "quota reached" in quota_reason
+                        else None
+                    ),
+                )
             counts = self._counts()
+            occupancy = counts["queued"] + counts["quarantined"]
+            shed_limit = self._shed_occupancy_limit(priority)
             if (
-                counts["queued"] + counts["quarantined"] >= self._cfg.max_queue
-                # The precheck saw a full queue and skipped the lint; if
-                # it drained in the (subprocess-free, microsecond) gap,
-                # still reject as queue-full rather than admit an
-                # UNLINTED job — the client's retry gets the real
+                occupancy >= shed_limit
+                # The precheck saw a full/shedding/over-quota pool and
+                # skipped the lint; if it drained in the (subprocess-
+                # free, microsecond) gap, still reject rather than admit
+                # an UNLINTED job — the client's retry gets the real
                 # verdict.
                 or (queue_full and lint is None and self._cfg.admission_lint)
             ):
                 self._counters.inc("rejected")
+                hint = self._retry_after(counts, priority)
+                if shed_limit < self._cfg.max_queue:
+                    # Adaptive overload shedding: this class's threshold
+                    # tripped BEFORE the hard cap — the pool is
+                    # degrading gracefully, lowest class first.
+                    self._counters.inc("sheds")
+                    raise AdmissionError(
+                        f"overloaded: shedding {priority} submissions "
+                        f"({occupancy} waiting >= {shed_limit} "
+                        f"= {self._shed_thresholds.get(priority, 1.0):.0%}"
+                        f" of {self._cfg.max_queue})",
+                        retry_after_s=hint,
+                    )
                 raise AdmissionError(
                     f"queue full ({self._cfg.max_queue} waiting jobs)",
-                    retry_after_s=self._retry_after(counts),
+                    retry_after_s=hint,
                 )
             if idempotency_key is not None:
                 # Re-check under the final lock: a concurrent submit of
@@ -1343,6 +1698,9 @@ class CheckerService:
                 max_states=max_states,
                 chaos=chaos,
                 idempotency_key=idempotency_key,
+                tenant=tenant,
+                priority=priority,
+                deadline_s=deadline_s,
             )
             job.lint = lint
             job.engine_force = "host" if engine == "host" else None
@@ -1391,14 +1749,33 @@ class CheckerService:
                 spent_s=job.consumed_s or None,
                 seed_checkpoint=job.seed_checkpoint,
                 trace_id=job.trace_id,
+                tenant=tenant,
+                priority=priority,
+                deadline_s=deadline_s,
             )
             self._jlog(
                 "admitted",
                 job=job.id,
                 lint_ok=None if lint is None else lint["ok"],
             )
+            # Compile-on-admit (docs/service.md "QoS & overload"): a
+            # user family's (STPU_FAMILIES) first admission pre-banks
+            # its compile-plan shapes into .jax_cache in a background
+            # warm_cache subprocess — the new tenant's first real job
+            # never pays cold XLA compiles inside its wall-clock budget.
+            warm_family = None
+            if (
+                self._cfg.warm_user_families
+                and family not in registry.FAMILIES
+                and not self._warm_started.get(family)
+            ):
+                self._warm_started[family] = True
+                self._counters.inc("warm_compiles")
+                warm_family = family
             self._ensure_scheduler()
             self._cond.notify_all()
+        if warm_family is not None:
+            self._spawn_warm(warm_family, spec)
         if self._tracer.enabled:
             # Root span of the submission's trace — the attempt spans'
             # parent. Emitted outside the lock (one appended JSONL
@@ -1496,6 +1873,7 @@ class CheckerService:
                     and self._breaker == "open"
                 )
                 if slots > 0:
+                    eligible: List[Job] = []
                     for jid in self._order:
                         job = self._jobs[jid]
                         if job.kind != "batch":
@@ -1510,11 +1888,14 @@ class CheckerService:
                             )
                             continue
                         if job.status in ("queued", "quarantined"):
-                            job.status = "running"
-                            to_start.append(job)
-                            slots -= 1
-                            if slots == 0:
-                                break
+                            eligible.append(job)
+                    # The QoS pick (docs/service.md "QoS & overload")
+                    # replaces the old FIFO scan: weighted fair share
+                    # across classes, EDF within a class, aging as the
+                    # starvation backstop, tenant in-flight quotas.
+                    for job in self._qos_pick(eligible, slots):
+                        job.status = "running"
+                        to_start.append(job)
                 if not to_start:
                     # Event-driven idle: submit/requeue/close all notify.
                     # A timed wait is only needed to release a quarantine
@@ -1543,6 +1924,113 @@ class CheckerService:
                         name=f"stpu-service-mux-{unit[0].id}", daemon=True,
                     ).start()
 
+    def _edf_deadline(self, job: Job) -> float:
+        """EDF sort key: the absolute soft deadline (submission time +
+        ``deadline_s``); no deadline sorts last within the class."""
+        if job.deadline_s is None:
+            return float("inf")
+        return job.created_unix_ts + job.deadline_s
+
+    def _aged(self, job: Job, now_unix: float) -> bool:
+        """The starvation backstop (docs/service.md "QoS & overload"): a
+        queued job's effective priority ``w_class + waited_s /
+        qos_aging_s`` rises monotonically; once it clears ``w_max + 1``
+        — i.e. ``waited_s >= qos_aging_s * (w_max + 1 - w_class)`` —
+        the job jumps the fair-share rotation entirely. That product is
+        THE documented worst-case wait before any admitted job is
+        scheduled ahead of every un-aged sibling (defaults: best_effort
+        2400 s, batch 1800 s, interactive 600 s)."""
+        w = self._class_weights.get(job.priority, 1.0)
+        bound = self._cfg.qos_aging_s * (self._w_max + 1.0 - w)
+        return now_unix - job.created_unix_ts >= bound
+
+    def _qos_pick(self, eligible: List[Job], slots: int) -> List[Job]:
+        """The scheduling-round pick (caller holds the lock): up to
+        ``slots`` jobs from ``eligible`` (submission-ordered runnable
+        batch jobs), chosen by
+
+        1. **tenant in-flight quota** — a tenant at its ``max_inflight``
+           is skipped this round (its jobs stay queued, costing nothing);
+        2. **aging** — any job past its aged bound (:meth:`_aged`) is
+           picked first, oldest first (counter ``aged_picks``): EDF
+           churn or a heavier sibling class can never starve an
+           admitted job beyond the documented bound;
+        3. **weighted fair share** — stride scheduling across classes:
+           the class with the lowest pass (``served / weight``) among
+           those with runnable jobs wins the slot, so under sustained
+           contention class c receives ``w_c / Σ w`` of the slots. A
+           class with nothing runnable forfeits the credit it would
+           accrue while idle (its pass floor ratchets to the active
+           minimum) — returning traffic resumes at fair share instead
+           of bursting on banked credit;
+        4. **EDF within the class** — earliest absolute deadline first,
+           deadline-less jobs last, FIFO as the tiebreak."""
+        picks: List[Job] = []
+        if not eligible or slots <= 0:
+            return picks
+        inflight: Dict[str, int] = {}
+        for j in self._jobs.values():
+            if j.kind == "batch" and j.status == "running":
+                inflight[j.tenant] = inflight.get(j.tenant, 0) + 1
+        fifo = {id(job): i for i, job in enumerate(eligible)}
+        now_unix = time.time()
+        remaining = list(eligible)
+        while len(picks) < slots and remaining:
+            candidates = []
+            for job in remaining:
+                cap = self._tenant_quota(job.tenant)["max_inflight"]
+                if cap is not None and inflight.get(job.tenant, 0) >= cap:
+                    continue
+                candidates.append(job)
+            if not candidates:
+                break
+            aged = [j for j in candidates if self._aged(j, now_unix)]
+            if aged:
+                job = min(
+                    aged,
+                    key=lambda j: (j.created_unix_ts, fifo[id(j)]),
+                )
+                self._counters.inc("aged_picks")
+            else:
+                by_class: Dict[str, List[Job]] = {}
+                for j in candidates:
+                    by_class.setdefault(j.priority, []).append(j)
+
+                def eff_pass(cls: str) -> float:
+                    w = self._class_weights.get(cls, 1.0)
+                    return max(
+                        self._qos_served.get(cls, 0) / w,
+                        self._qos_floor.get(cls, 0.0),
+                    )
+
+                min_active = min(eff_pass(c) for c in by_class)
+                for cls in self._class_weights:
+                    if cls not in by_class:
+                        self._qos_floor[cls] = max(
+                            self._qos_floor.get(cls, 0.0), min_active
+                        )
+                cls = min(
+                    by_class,
+                    key=lambda c: (
+                        eff_pass(c), -self._class_weights.get(c, 1.0)
+                    ),
+                )
+                job = min(
+                    by_class[cls],
+                    key=lambda j: (
+                        self._edf_deadline(j),
+                        j.created_unix_ts,
+                        fifo[id(j)],
+                    ),
+                )
+            self._qos_served[job.priority] = (
+                self._qos_served.get(job.priority, 0) + 1
+            )
+            inflight[job.tenant] = inflight.get(job.tenant, 0) + 1
+            picks.append(job)
+            remaining.remove(job)
+        return picks
+
     def _mux_partition(self, to_start: List[Job]) -> List[List[Job]]:
         """Partition a scheduling round's picks into mux groups (same
         spec, up to ``mux_k`` lanes) and solo singletons. Caller holds
@@ -1557,7 +2045,11 @@ class CheckerService:
         member whose previous mux attempt faulted retries solo
         (``_mux_solo``). Migration seeds (``seed_checkpoint``) stay solo
         too: a migrated-in job's adopted rotation can arrive at grown
-        capacities the fresh sibling lanes don't share."""
+        capacities the fresh sibling lanes don't share. Groups form
+        WITHIN a priority class ((spec, priority) key): the group budget
+        is the tightest member's, and batching across classes would let
+        a best-effort lane ride — and clip — an interactive dispatch's
+        budget (docs/service.md "QoS & overload")."""
         if self._cfg.mux_k <= 1 or self._breaker != "closed":
             return [[job] for job in to_start]
 
@@ -1573,10 +2065,10 @@ class CheckerService:
             return family in registry.MUX_FAMILIES
 
         groups: List[List[Job]] = []
-        by_spec: Dict[str, List[Job]] = {}
+        by_spec: Dict[Any, List[Job]] = {}
         for job in to_start:
             if eligible(job):
-                by_spec.setdefault(job.spec, []).append(job)
+                by_spec.setdefault((job.spec, job.priority), []).append(job)
             else:
                 groups.append([job])
         for members in by_spec.values():
@@ -1624,6 +2116,7 @@ class CheckerService:
                 job.error = f"supervisor error: {type(e).__name__}: {e}"
                 job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._record_drain(job.priority)
                 self._jlog(
                     "completed", job=job.id, status="failed",
                     error=job.error, result=None,
@@ -1663,6 +2156,7 @@ class CheckerService:
                 job.error = "wall-clock budget exhausted"
                 job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._record_drain(job.priority)
                 self._jlog(
                     "completed", job=job.id, status="failed",
                     error=job.error, result=None,
@@ -1828,6 +2322,7 @@ class CheckerService:
                     job.degraded = True
                     self._counters.inc("degraded_jobs")
                 self._counters.inc("jobs_done")
+                self._record_drain(job.priority)
                 if device:
                     self._consecutive_wedges = 0
                 self._jlog(
@@ -1852,6 +2347,7 @@ class CheckerService:
                 job.error = "wall-clock budget exhausted"
                 job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._record_drain(job.priority)
                 self._jlog(
                     "completed", job=job.id, status="failed",
                     error=job.error, result=None,
@@ -1861,6 +2357,7 @@ class CheckerService:
                 job.error = f"worker exited rc={res.rc}"
                 job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_failed")
+                self._record_drain(job.priority)
                 self._jlog(
                     "completed", job=job.id, status="failed",
                     error=job.error, result=None,
@@ -1886,6 +2383,7 @@ class CheckerService:
                     job.error = f"supervisor error: {type(e).__name__}: {e}"
                     job.completed_unix_ts = time.time()
                     self._counters.inc("jobs_failed")
+                    self._record_drain(job.priority)
                     self._jlog(
                         "completed", job=job.id, status="failed",
                         error=job.error, result=None,
@@ -1933,6 +2431,7 @@ class CheckerService:
                     job.error = "wall-clock budget exhausted"
                     job.completed_unix_ts = time.time()
                     self._counters.inc("jobs_failed")
+                    self._record_drain(job.priority)
                     self._jlog(
                         "completed", job=job.id, status="failed",
                         error=job.error, result=None,
@@ -2139,6 +2638,7 @@ class CheckerService:
                 job.result = results[job.id]
                 job.completed_unix_ts = time.time()
                 self._counters.inc("jobs_done")
+                self._record_drain(job.priority)
                 self._jlog(
                     "completed", job=job.id, status="done", error=None,
                     result=job.persist()["result"],
@@ -2179,6 +2679,7 @@ class CheckerService:
                             job.error = "wall-clock budget exhausted"
                             job.completed_unix_ts = time.time()
                             self._counters.inc("jobs_failed")
+                            self._record_drain(job.priority)
                             self._jlog(
                                 "completed", job=job.id, status="failed",
                                 error=job.error, result=None,
@@ -2191,6 +2692,7 @@ class CheckerService:
                         job.error = f"mux worker exited rc={res.rc}"
                         job.completed_unix_ts = time.time()
                         self._counters.inc("jobs_failed")
+                        self._record_drain(job.priority)
                         self._jlog(
                             "completed", job=job.id, status="failed",
                             error=job.error, result=None,
@@ -2247,6 +2749,7 @@ class CheckerService:
             job.error = f"{reason}; requeue limit reached"
             job.completed_unix_ts = time.time()
             self._counters.inc("jobs_failed")
+            self._record_drain(job.priority)
             self._jlog(
                 "completed", job=job.id, status="failed",
                 error=job.error, result=None,
@@ -2445,6 +2948,45 @@ class CheckerService:
             collect_mod.write(self._cfg.run_dir, dst)
         return dst
 
+    def _qos_gauges(self) -> Dict[str, Any]:
+        """The per-class / per-tenant QoS breakdown (caller holds the
+        lock): ``gauges()``'s ``"qos"`` dict — the dashboard's class
+        tiles and the ``/.metrics`` ``class=``/``tenant=`` labeled
+        samples render from it (docs/observability.md)."""
+        classes: Dict[str, Dict[str, Any]] = {
+            cls: {
+                "queued": 0, "running": 0, "quarantined": 0,
+                "done": 0, "failed": 0, "migrated": 0,
+                "weight": self._class_weights.get(cls, 1.0),
+                "served": self._qos_served.get(cls, 0),
+                "drain_per_s": self._drain_rate(cls),
+            }
+            for cls in PRIORITY_CLASSES
+        }
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for j in self._jobs.values():
+            if j.kind != "batch":
+                continue
+            row = classes.get(j.priority)
+            if row is not None and j.status in row:
+                row[j.status] += 1
+            t = tenants.setdefault(
+                j.tenant,
+                {"queued": 0, "running": 0, "done": 0, "failed": 0,
+                 "spent_s": 0.0},
+            )
+            if j.status in ("queued", "quarantined"):
+                t["queued"] += 1
+            elif j.status in t:
+                t[j.status] += 1
+            t["spent_s"] = round(t["spent_s"] + j.consumed_s, 3)
+        return {
+            "classes": classes,
+            "tenants": tenants,
+            "aging_s": self._cfg.qos_aging_s,
+            "drain_per_s": self._drain_rate(),
+        }
+
     def gauges(self) -> Dict[str, Any]:
         """The pool-wide snapshot without per-job payloads — what the
         Explorer embeds under ``/.status``'s ``"pool"`` key."""
@@ -2452,6 +2994,7 @@ class CheckerService:
             counts = self._counts()
             return {
                 **counts,
+                "qos": self._qos_gauges(),
                 "device": self._cfg.device,
                 "max_inflight": self._cfg.max_inflight,
                 "max_queue": self._cfg.max_queue,

@@ -78,6 +78,8 @@ FLEET_COUNTERS = (
     "host_last_resort",
     "idem_dedups",
     "jobs_recovered",
+    "pools_quiesced",
+    "pools_woken",
 )
 
 
@@ -107,6 +109,18 @@ class FleetConfig:
     pool: Optional[ServiceConfig] = None
     #: Interactive sessions cap, fleet-wide (None = sum of pool caps).
     max_sessions: Optional[int] = None
+    # -- elastic pools (docs/service.md "QoS & overload") ------------------
+    #: Idle pools quiesce (drop out of routing; their workers are already
+    #: reaped — a pool only quiesces at zero load) and wake under queue
+    #: pressure. Quiesce/wake decisions are journaled (``quiesced`` /
+    #: ``woken`` fleet events) so a restart resumes the same active set.
+    elastic: bool = False
+    #: A pool must sit at zero load this long before the monitor
+    #: quiesces it.
+    idle_quiesce_s: float = 30.0
+    #: Never quiesce below this many active (non-lost, non-quiesced)
+    #: pools.
+    min_active: int = 1
     #: Distributed tracing (docs/observability.md "Distributed tracing"):
     #: True → fleet route/migrate spans to ``<run_dir>/trace.jsonl`` (and
     #: each pool, unless its template says otherwise, traces to its own
@@ -138,6 +152,11 @@ class FleetJob:
         #: re-attach the routed pool job (torn/lost pool journal, or a
         #: smaller fleet): enough to re-route the work from scratch.
         self._orphan_spec: Optional[str] = None
+        #: QoS identity (docs/service.md "QoS & overload") — journaled on
+        #: ``routed`` so migrations and orphan re-routes keep the class.
+        self.tenant: str = "default"
+        self.priority: str = "batch"
+        self.deadline_s: Optional[float] = None
         self.created_unix_ts = time.time()
         #: Fleet-minted distributed-trace id — stable across migrations
         #: (every hop's pool job carries the same one).
@@ -227,6 +246,9 @@ class FleetJob:
             migrations=len(self.migrations),
             recovered=out.get("recovered", False) or self.recovered,
             trace_id=self.trace_id or out.get("trace_id"),
+            tenant=self.tenant,
+            priority=self.priority,
+            deadline_s=self.deadline_s,
         )
         return out
 
@@ -247,6 +269,7 @@ def _fleet_replay(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "idem": {},
         "counters": {},
         "migrations": {},
+        "quiesced": set(),
     }
 
     def inc(name: str, n: int = 1) -> None:
@@ -265,8 +288,17 @@ def _fleet_replay(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             state["idem"] = dict(s.get("idem", {}))
             state["counters"] = dict(s.get("counters", {}))
             state["migrations"] = dict(s.get("migrations", {}))
+            state["quiesced"] = set(s.get("quiesced", []))
             continue
         if ev == "recovered":
+            continue
+        if ev == "quiesced":
+            state["quiesced"].add(rec["device"])
+            inc("pools_quiesced")
+            continue
+        if ev == "woken":
+            state["quiesced"].discard(rec["device"])
+            inc("pools_woken")
             continue
         fid = rec.get("job")
         if fid is None:
@@ -278,6 +310,9 @@ def _fleet_replay(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "spec": rec.get("spec"),
                 "idempotency_key": rec.get("idempotency_key"),
                 "trace_id": rec.get("trace_id"),
+                "tenant": rec.get("tenant", "default"),
+                "priority": rec.get("priority", "batch"),
+                "deadline_s": rec.get("deadline_s"),
             }
             if fid not in state["order"]:
                 state["order"].append(fid)
@@ -332,6 +367,8 @@ class FleetService:
         self._idem: Dict[str, str] = {}
         self._next_id = 0
         self._lost: set = set()  #: device indices declared dead
+        self._quiesced: set = set()  #: elastic pools out of routing
+        self._idle_since: Dict[int, float] = {}  #: monotonic idle marks
         self._closed = False
         self._monitor: Optional[threading.Thread] = None
         self._wake = threading.Event()  #: breaker listeners pulse this
@@ -470,6 +507,7 @@ class FleetService:
                 for fid, j in self._jobs.items()
                 if j.migrations
             },
+            "quiesced": sorted(self._quiesced),
             "routes": {
                 fid: {
                     "device": j.device,
@@ -482,6 +520,9 @@ class FleetService:
                     ),
                     "idempotency_key": j.idempotency_key,
                     "trace_id": j.trace_id,
+                    "tenant": j.tenant,
+                    "priority": j.priority,
+                    "deadline_s": j.deadline_s,
                 }
                 for fid, j in self._jobs.items()
                 # A reserved-but-still-routing handle must not be
@@ -509,6 +550,10 @@ class FleetService:
             )
             self._next_id = max(self._next_id, state["next_id"])
             self._idem.update(state["idem"])
+            self._quiesced = {
+                i for i in state["quiesced"]
+                if isinstance(i, int) and 0 <= i < len(self.pools)
+            }
             for name, value in state["counters"].items():
                 if value and name != "jobs_recovered":
                     self._counters.inc(name, value)
@@ -519,6 +564,9 @@ class FleetService:
                 )
                 fjob.recovered = True
                 fjob.trace_id = route.get("trace_id")
+                fjob.tenant = route.get("tenant", "default")
+                fjob.priority = route.get("priority", "batch")
+                fjob.deadline_s = route.get("deadline_s")
                 fjob.migrations = [
                     {"recovered": True}
                 ] * state["migrations"].get(fid, 0)
@@ -597,6 +645,9 @@ class FleetService:
                     fjob.device = device
                     fjob.pool_job = job
                     fjob.trace_id = job.trace_id
+                    fjob.tenant = job.tenant
+                    fjob.priority = job.priority
+                    fjob.deadline_s = job.deadline_s
                     self._jobs[fid] = fjob
                     self._order.append(fid)
                     self._idem[job.idempotency_key] = fid
@@ -607,6 +658,8 @@ class FleetService:
                         idempotency_key=job.idempotency_key,
                         adopted=True,
                         trace_id=job.trace_id,
+                        tenant=job.tenant, priority=job.priority,
+                        deadline_s=job.deadline_s,
                     )
                     attached += 1
             self._recovery = {
@@ -625,14 +678,119 @@ class FleetService:
         g = self.pools[i].gauges()
         return g["queued"] + g["quarantined"] + g["running"]
 
+    def _route_load(self, i: int, priority: Optional[str] = None) -> float:
+        """Routing cost: total backlog, plus the same-class backlog again
+        when the submission carries a priority — two devices equally busy
+        overall tie-break toward the one with less SAME-class contention,
+        so one tenant's interactive burst spreads instead of piling onto
+        a single pool's interactive queue (docs/service.md
+        "QoS & overload")."""
+        g = self.pools[i].gauges()
+        load = float(g["queued"] + g["quarantined"] + g["running"])
+        if priority is not None:
+            row = (g.get("qos") or {}).get("classes", {}).get(priority)
+            if row:
+                load += row.get("queued", 0) + row.get("running", 0)
+        return load
+
     def _healthy_devices(self) -> List[int]:
         return [
             i for i in range(len(self.pools))
-            if i not in self._lost and not self.pools[i].degraded
+            if i not in self._lost and i not in self._quiesced
+            and not self.pools[i].degraded
         ]
 
     def _alive_devices(self) -> List[int]:
         return [i for i in range(len(self.pools)) if i not in self._lost]
+
+    # -- elastic pools (docs/service.md "QoS & overload") ------------------
+
+    def quiesce_pool(self, i: int, reason: str = "idle") -> bool:
+        """Take pool ``i`` out of routing (journaled ``quiesced`` event).
+        Refused (False) when it would drop the active pool count below
+        ``min_active``, or the pool is lost/already quiesced. A quiesce
+        with work still on the pool is just a scale-down: the jobs
+        evacuate and the monitor migrates them — the same journaled
+        path a breaker trip takes."""
+        with self._lock:
+            if self._closed or i in self._quiesced or i in self._lost or not (
+                0 <= i < len(self.pools)
+            ):
+                return False
+            active = [
+                d for d in range(len(self.pools))
+                if d not in self._lost and d not in self._quiesced
+            ]
+            if len(active) <= max(1, self._cfg.min_active):
+                return False
+            self._quiesced.add(i)
+            self._idle_since.pop(i, None)
+            self._counters.inc("pools_quiesced")
+            self._jlog("quiesced", device=i, reason=reason)
+        self.log(f"device-{i} quiesced ({reason})")
+        if self._pool_load(i):
+            self.pools[i].evacuate(reason=f"device-{i} quiesced")
+            self._ensure_monitor()
+            self._wake.set()
+        return True
+
+    def wake_pool(self, i: int, reason: str = "pressure") -> bool:
+        """Return a quiesced pool to routing (journaled ``woken``)."""
+        with self._lock:
+            if self._closed or i not in self._quiesced or i in self._lost:
+                return False
+            self._quiesced.discard(i)
+            self._idle_since.pop(i, None)
+            self._counters.inc("pools_woken")
+            self._jlog("woken", device=i, reason=reason)
+        self.log(f"device-{i} woken ({reason})")
+        return True
+
+    def _wake_for_pressure(self) -> Optional[int]:
+        """Wake the lowest-numbered quiesced pool; None when there is
+        nothing to wake."""
+        with self._lock:
+            candidates = sorted(self._quiesced - self._lost)
+        for i in candidates:
+            if self.wake_pool(i, reason="queue pressure"):
+                return i
+        return None
+
+    def _elastic_sweep(self) -> None:
+        """One monitor-cadence elastic pass: wake a pool when every
+        active pool is backlogged past its in-flight capacity; quiesce
+        pools idle past ``idle_quiesce_s`` (down to ``min_active``)."""
+        with self._lock:
+            if self._closed:
+                return
+            active = [
+                i for i in range(len(self.pools))
+                if i not in self._lost and i not in self._quiesced
+            ]
+            quiesced = sorted(self._quiesced - self._lost)
+        if quiesced and active and all(
+            self._pool_load(i) > max(self.pools[i]._cfg.max_inflight, 1)
+            for i in active
+        ):
+            self.wake_pool(quiesced[0], reason="queue pressure")
+            return
+        now = time.monotonic()
+        # Loads read OUTSIDE the fleet lock (gauges take each pool's own
+        # lock — same ordering as every other fleet->pool call).
+        loads = {i: self._pool_load(i) for i in active}
+        idle_for: Dict[int, float] = {}
+        with self._lock:
+            for i in active:
+                if loads[i] == 0:
+                    since = self._idle_since.setdefault(i, now)
+                    idle_for[i] = now - since
+                else:
+                    self._idle_since.pop(i, None)
+        for i, idled in idle_for.items():
+            if idled >= self._cfg.idle_quiesce_s:
+                self.quiesce_pool(
+                    i, reason=f"idle {self._cfg.idle_quiesce_s:g}s"
+                )
 
     def submit(
         self,
@@ -642,12 +800,21 @@ class FleetService:
         max_states: Optional[int] = None,
         chaos: Optional[Dict[str, Any]] = None,
         idempotency_key: Optional[str] = None,
+        tenant: str = "default",
+        priority: str = "batch",
+        deadline_s: Optional[float] = None,
     ) -> FleetJob:
-        """Route one batch job to the least-loaded healthy device (host
-        last resort when none is healthy); returns the :class:`FleetJob`
+        """Route one batch job to the least-loaded healthy device —
+        class-aware: same-class backlog counts double, so a class's
+        burst spreads (host last resort when none is healthy; a fleet
+        with quiesced elastic pools wakes one under pressure before
+        either degrading or rejecting); returns the :class:`FleetJob`
         or raises :class:`AdmissionError` when every candidate rejects
         (the hint is the minimum Retry-After across devices — the
-        soonest any of them expects room)."""
+        soonest any of them expects room). ``tenant``/``priority``/
+        ``deadline_s`` ride into the pool submission (per-pool quotas,
+        fair-share, shedding) and are journaled on ``routed`` so a
+        restart or migration keeps the class."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("fleet is closed")
@@ -668,6 +835,9 @@ class FleetService:
             # migration hop's resubmission) joins it rather than minting
             # its own, so one submission is ONE trace end to end.
             fjob.trace_id = new_trace_id()
+            fjob.tenant = tenant
+            fjob.priority = priority
+            fjob.deadline_s = deadline_s
             self._jobs[fjob.id] = fjob
             self._order.append(fjob.id)
             if idempotency_key is not None:
@@ -686,7 +856,10 @@ class FleetService:
                 )
                 if flaky_inj.get("once", 1):
                     chaos.setdefault("marker", True)
-            healthy = sorted(self._healthy_devices(), key=self._pool_load)
+            healthy = sorted(
+                self._healthy_devices(),
+                key=lambda i: self._route_load(i, priority),
+            )
             pool_job: Optional[Job] = None
             device: Optional[int] = None
             forced_host = False
@@ -700,6 +873,9 @@ class FleetService:
                         chaos=chaos,
                         idempotency_key=idempotency_key,
                         trace_id=fjob.trace_id,
+                        tenant=tenant,
+                        priority=priority,
+                        deadline_s=deadline_s,
                     )
                     device = i
                     break
@@ -709,6 +885,30 @@ class FleetService:
                         # Budget/lint rejection: identical on every
                         # device — trying the siblings is pure waste.
                         break
+            if pool_job is None and all(
+                e.retry_after_s is not None for e in rejections
+            ):
+                # Elastic wake-on-pressure: a quiesced pool beats both
+                # host degradation and a queue-full/shed rejection. (A
+                # hint-less rejection — budget, lint — is identical on
+                # every pool, so waking one wouldn't help.)
+                woken = self._wake_for_pressure()
+                if woken is not None:
+                    try:
+                        pool_job = self.pools[woken].submit(
+                            spec,
+                            max_seconds=max_seconds,
+                            max_states=max_states,
+                            chaos=chaos,
+                            idempotency_key=idempotency_key,
+                            trace_id=fjob.trace_id,
+                            tenant=tenant,
+                            priority=priority,
+                            deadline_s=deadline_s,
+                        )
+                        device = woken
+                    except AdmissionError as e:
+                        rejections.append(e)
             if pool_job is None and not rejections:
                 # No healthy device at all: the last resort. Host engine
                 # on the least-loaded ALIVE pool — degradation only when
@@ -727,6 +927,9 @@ class FleetService:
                         idempotency_key=idempotency_key,
                         engine="host",
                         trace_id=fjob.trace_id,
+                        tenant=tenant,
+                        priority=priority,
+                        deadline_s=deadline_s,
                     )
                     device = alive[0]
                     forced_host = True
@@ -774,6 +977,7 @@ class FleetService:
                 pool_job=pool_job.id, idempotency_key=idempotency_key,
                 host=forced_host or None,
                 trace_id=fjob.trace_id,
+                tenant=tenant, priority=priority, deadline_s=deadline_s,
             )
             landed_lost = device in self._lost
         if self._tracer.enabled:
@@ -896,6 +1100,11 @@ class FleetService:
                     # Migration keeps the victim's trace: the new hop's
                     # spans stitch onto the same timeline.
                     trace_id=fjob.trace_id or old.trace_id,
+                    # ... and its QoS identity: the new hop schedules in
+                    # the same class under the same tenant's quotas.
+                    tenant=old.tenant,
+                    priority=old.priority,
+                    deadline_s=old.deadline_s,
                 )
                 reason = old.error
                 requeues = old.requeues
@@ -912,12 +1121,30 @@ class FleetService:
                         )
                     continue
                 seed = None
-                resume_kwargs = (
-                    {"trace_id": fjob.trace_id} if fjob.trace_id else {}
+                resume_kwargs = dict(
+                    tenant=fjob.tenant,
+                    priority=fjob.priority,
+                    deadline_s=fjob.deadline_s,
                 )
+                if fjob.trace_id:
+                    resume_kwargs["trace_id"] = fjob.trace_id
                 reason = "orphaned by fleet restart"
                 requeues = 0
-            healthy = sorted(self._healthy_devices(), key=self._pool_load)
+            healthy = sorted(
+                self._healthy_devices(),
+                key=lambda d: self._route_load(
+                    d, resume_kwargs.get("priority")
+                ),
+            )
+            if not healthy and self._wake_for_pressure() is not None:
+                # Migrating onto a woken elastic pool beats forcing the
+                # host engine.
+                healthy = sorted(
+                    self._healthy_devices(),
+                    key=lambda d: self._route_load(
+                        d, resume_kwargs.get("priority")
+                    ),
+                )
             candidates = healthy or sorted(
                 self._alive_devices(), key=self._pool_load
             )
@@ -1045,6 +1272,8 @@ class FleetService:
                             )
                             pool.evacuate(reason=f"device-{i} breaker open")
                 self._migrate_stragglers()
+                if self._cfg.elastic:
+                    self._elastic_sweep()
             except Exception as e:  # noqa: BLE001 - monitor must survive
                 # A dead monitor stalls every pending migration and
                 # hangs waiters; log the sweep's failure and keep going.
@@ -1070,6 +1299,17 @@ class FleetService:
                         for j in self._jobs.values()
                     )
                     and not self._wake.is_set()
+                    # An elastic fleet keeps sweeping until the idle
+                    # pools have quiesced down to min_active — only then
+                    # is there nothing left for the monitor to do.
+                    and (
+                        not self._cfg.elastic
+                        or len([
+                            i for i in range(len(self.pools))
+                            if i not in self._lost
+                            and i not in self._quiesced
+                        ]) <= max(1, self._cfg.min_active)
+                    )
                 ):
                     self._monitor = None
                     return
@@ -1109,9 +1349,28 @@ class FleetService:
             self._device_label(i): dict(
                 pool.gauges(),
                 lost=(i in self._lost),
+                quiesced=(i in self._quiesced),
             )
             for i, pool in enumerate(self.pools)
         }
+        # Fleet-wide per-class/per-tenant rollup: count keys sum across
+        # devices; weight is a config constant, taken from any row.
+        qos_classes: Dict[str, Dict[str, Any]] = {}
+        qos_tenants: Dict[str, Dict[str, Any]] = {}
+        for d in devices.values():
+            qos = d.get("qos") or {}
+            for cls, row in (qos.get("classes") or {}).items():
+                agg = qos_classes.setdefault(
+                    cls, {"weight": row.get("weight")}
+                )
+                for k in ("queued", "running", "quarantined", "done",
+                          "failed", "migrated", "served"):
+                    agg[k] = agg.get(k, 0) + (row.get(k) or 0)
+            for tenant, row in (qos.get("tenants") or {}).items():
+                agg = qos_tenants.setdefault(tenant, {})
+                for k in ("queued", "running", "done", "failed",
+                          "spent_s"):
+                    agg[k] = agg.get(k, 0) + (row.get(k) or 0)
         agg_keys = (
             "queued", "running", "quarantined", "interactive", "done",
             "failed", "migrated", "jobs_done", "jobs_failed",
@@ -1130,6 +1389,9 @@ class FleetService:
                 device_count=len(self.pools),
                 healthy_devices=len(healthy),
                 lost_devices=sorted(self._lost),
+                quiesced_devices=sorted(self._quiesced),
+                elastic=self._cfg.elastic,
+                qos={"classes": qos_classes, "tenants": qos_tenants},
                 breaker={
                     # The fleet-level verdict the dashboard badge renders:
                     # open only when NO device can take device work.

@@ -121,6 +121,24 @@ function hbBadge(age) {
   return `<span class="badge ${cls}"><span class="dot"></span>heartbeat ${word} · ${ageLabel(age)}</span>`;
 }
 
+// QoS class tiles (docs/service.md "QoS & overload"): one tile per
+// priority class with live queue/run occupancy and the fair-share
+// weight, plus shed/quota counters when the overload tier has acted.
+function qosTiles(pool) {
+  const qos = pool.qos;
+  if (!qos || !qos.classes) return "";
+  let html = Object.keys(qos.classes).map((cls) => {
+    const c = qos.classes[cls];
+    return `<div class="tile"><div class="v">${fmt(c.queued)}+${fmt(c.running)}</div>` +
+      `<div class="k">${escapeHtml(cls)} (w=${fmt(c.weight)})</div></div>`;
+  }).join("");
+  if (pool.sheds || pool.quota_rejects) {
+    html += `<div class="tile"><div class="v">${fmt(pool.sheds || 0)}</div>` +
+      `<div class="k">shed (${fmt(pool.quota_rejects || 0)} quota)</div></div>`;
+  }
+  return html;
+}
+
 function renderPool(pool) {
   const tiles = [
     ["queued", pool.queued], ["in flight", pool.running],
@@ -141,7 +159,8 @@ function renderPool(pool) {
       `<div class="k">lane occupancy (${fmt(pool.mux_groups)} batches · ` +
       `${fmt(pool.mux_dispatches_saved)} dispatches saved)</div></div>` : "") +
     (pool.journal ? `<div class="tile"><div class="v">${fmt(pool.journal.records)}</div>` +
-      `<div class="k">journal records</div></div>` : "");
+      `<div class="k">journal records</div></div>` : "") +
+    qosTiles(pool);
 
   queueRing.push({ queued: (pool.queued || 0) + (pool.quarantined || 0),
                    running: pool.running || 0 });
@@ -163,6 +182,10 @@ function renderPool(pool) {
 function deviceBadge(dev) {
   if (dev.lost)
     return `<span class="badge serious"><span class="dot"></span>LOST</span>`;
+  // Elastic pools (docs/service.md "QoS & overload"): a quiesced pool
+  // is healthy but parked — it wakes on queue pressure.
+  if (dev.quiesced)
+    return `<span class="badge"><span class="dot"></span>quiesced</span>`;
   const open = dev.breaker && dev.breaker.state === "open";
   return open
     ? `<span class="badge warning"><span class="dot"></span>breaker open</span>`
@@ -203,6 +226,9 @@ function jobCard(id, job) {
     `<h3><span class="mono">${escapeHtml(id)}</span>${statusBadge(job)}</h3>` +
     `<div class="meta">${escapeHtml(job.spec || "")} · ${escapeHtml(engine || "")}` +
     ` · ${escapeHtml(job.kind || "batch")}` +
+    // QoS identity: priority class (+ tenant when not the default).
+    (job.priority && job.priority !== "batch" ? ` · ${escapeHtml(job.priority)}` : "") +
+    (job.tenant && job.tenant !== "default" ? ` · ${escapeHtml(job.tenant)}` : "") +
     // Mux membership: the lane this member rode (rates on this card are
     // the LANE's own — the batch total lives in the pool tiles).
     (job.mux ? ` · lane ${(job.mux.lane || 0) + 1}/${job.mux.lanes}` +

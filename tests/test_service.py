@@ -147,6 +147,216 @@ def test_admission_rejection_at_caps(tmp_path):
         svc.close()
 
 
+# --- QoS: priority classes, fair share, overload shedding (ISSUE 18) --------
+
+
+def test_smoke_qos_shed(tmp_path):
+    """The tier-0 QoS drill (<30s, tools/smoke.sh): overload sheds the
+    lowest class FIRST — typed, class-naming, hint-carrying — while
+    higher classes keep admitting up to their own thresholds, and the
+    ``qos`` gauge rollup tracks the class/tenant occupancy."""
+    svc = CheckerService(_config(tmp_path, max_inflight=1, max_queue=8))
+    svc._ensure_scheduler = lambda: None  # admission accounting only
+    try:
+        with pytest.raises(ValueError, match="priority"):
+            svc.submit("2pc:3", priority="platinum")
+        with pytest.raises(ValueError, match="deadline_s"):
+            svc.submit("2pc:3", deadline_s=-5)
+        for _ in range(4):
+            svc.submit("2pc:3", tenant="t-batch")  # occupancy 4 = 50 %
+        # best_effort sheds at half-full; batch and interactive do not.
+        with pytest.raises(AdmissionError) as exc:
+            svc.submit("2pc:3", priority="best_effort")
+        assert "overloaded: shedding best_effort" in exc.value.reason
+        assert exc.value.retry_after_s is not None
+        svc.submit("2pc:3")
+        svc.submit("2pc:3")  # occupancy 6 = 75 %
+        with pytest.raises(AdmissionError, match="shedding batch"):
+            svc.submit("2pc:3")
+        vip = svc.submit("2pc:3", priority="interactive", deadline_s=60)
+        svc.submit("2pc:3", priority="interactive")  # occupancy 8 = cap
+        # At the hard cap even interactive rejects — as queue-full, not
+        # a shed (there is no lower class left to degrade to).
+        with pytest.raises(AdmissionError, match="queue full"):
+            svc.submit("2pc:3", priority="interactive")
+        g = svc.gauges()
+        assert g["sheds"] == 2
+        qos = g["qos"]
+        assert qos["classes"]["batch"]["queued"] == 6
+        assert qos["classes"]["interactive"]["queued"] == 2
+        assert qos["classes"]["best_effort"]["queued"] == 0
+        assert qos["classes"]["interactive"]["weight"] == 4.0
+        assert qos["tenants"]["t-batch"]["queued"] == 4
+        assert qos["aging_s"] == svc._cfg.qos_aging_s
+        snap = vip.snapshot()
+        assert snap["priority"] == "interactive"
+        assert snap["tenant"] == "default"
+        assert snap["deadline_s"] == 60
+    finally:
+        svc.close()
+
+
+def test_starvation_freedom(tmp_path):
+    """The no-starvation guarantee: under a sustained higher-class
+    backlog, stride fair share already serves best_effort at w/Σw —
+    and any job older than ``qos_aging_s * (w_max + 1 - w_class)``
+    jumps the rotation entirely (``aged_picks``), so no admitted job
+    waits beyond the documented bound."""
+    svc = CheckerService(_config(
+        tmp_path, max_inflight=0, max_queue=64,
+        shed_thresholds={
+            "interactive": 1.0, "batch": 1.0, "best_effort": 1.0,
+        },
+    ))
+    svc._ensure_scheduler = lambda: None
+    try:
+        straggler = svc.submit("2pc:3", priority="best_effort")
+        hi = [
+            svc.submit("2pc:3", priority="interactive") for _ in range(8)
+        ]
+        # Fresh jobs: the deterministic stride order gives interactive
+        # exactly its 4:1 weighted share of the first 5 slots.
+        with svc._lock:
+            order = [
+                j.priority for j in svc._qos_pick([straggler] + hi, 5)
+            ]
+        assert order.count("interactive") == 4
+        assert order.count("best_effort") == 1
+        assert svc.gauges()["aged_picks"] == 0
+
+        # A best_effort job past the aged bound preempts EVERY fresh
+        # higher-class sibling — the starvation backstop.
+        aged_job = svc.submit("2pc:3", priority="best_effort")
+        bound = svc._cfg.qos_aging_s * (svc._w_max + 1.0 - 1.0)
+        with svc._lock:
+            assert not svc._aged(aged_job, time.time())
+            aged_job.created_unix_ts -= bound + 1.0
+            picks = svc._qos_pick([aged_job] + hi, 1)
+        assert picks == [aged_job]
+        assert svc.gauges()["aged_picks"] == 1
+    finally:
+        svc.close()
+
+
+def test_qos_edf_and_tenant_inflight_quota(tmp_path):
+    """Within a class the pick is earliest-deadline-first (deadline-less
+    jobs last); a tenant at its in-flight quota is skipped — not
+    starved — and the slot goes to another tenant's job."""
+    svc = CheckerService(_config(
+        tmp_path, max_inflight=0, max_queue=64,
+        tenant_quotas={"capped": {"max_inflight": 1}},
+    ))
+    svc._ensure_scheduler = lambda: None
+    try:
+        loose = svc.submit("2pc:3", priority="interactive")
+        tight = svc.submit(
+            "2pc:3", priority="interactive", deadline_s=30.0
+        )
+        with svc._lock:
+            picks = svc._qos_pick([loose, tight], 1)
+        assert picks == [tight]  # later submit, earlier deadline
+
+        a = svc.submit("2pc:3", tenant="capped")
+        b = svc.submit("2pc:3", tenant="capped")
+        other = svc.submit("2pc:3", tenant="free")
+        with svc._cond:
+            a.status = "running"  # capped is at max_inflight=1
+            picks = svc._qos_pick([b, other], 2)
+        # b skipped (quota), other picked; b stays eligible next round.
+        assert picks == [other]
+        with svc._cond:
+            a.status = "done"
+            picks = svc._qos_pick([b], 1)
+        assert picks == [b]
+    finally:
+        svc.close()
+
+
+def test_tenant_quotas_reject_typed(tmp_path):
+    """Per-tenant admission quotas: queued quota rejects with a drain
+    hint (the tenant's own jobs clearing makes room), a device-seconds
+    budget quota rejects with none (retrying cannot help) — both
+    counted as ``quota_rejects``."""
+    svc = CheckerService(_config(
+        tmp_path, max_inflight=0, max_queue=64,
+        tenant_max_queued=2,
+        tenant_quotas={"broke": {"budget_s": 50.0}},
+    ))
+    svc._ensure_scheduler = lambda: None
+    try:
+        svc.submit("2pc:3", tenant="t1")
+        svc.submit("2pc:3", tenant="t1")
+        with pytest.raises(AdmissionError) as exc:
+            svc.submit("2pc:3", tenant="t1")
+        assert "queued quota reached" in exc.value.reason
+        assert exc.value.retry_after_s is not None
+        # Another tenant is untouched by t1's quota.
+        svc.submit("2pc:3", tenant="t2")
+        with pytest.raises(AdmissionError) as exc:
+            svc.submit("2pc:3", tenant="broke", max_seconds=60.0)
+        assert "budget exceeded" in exc.value.reason
+        assert exc.value.retry_after_s is None
+        assert svc.gauges()["quota_rejects"] == 2
+    finally:
+        svc.close()
+
+
+def test_retry_after_uses_measured_drain_rate(tmp_path):
+    """The Retry-After hint is measured, not guessed: with two or more
+    completions in the drain window the hint is jobs-ahead over the
+    observed completion rate (per-class when the class has its own
+    settlements, pool-wide otherwise); below two it falls back to the
+    conservative slot estimate."""
+    import time as _time
+
+    svc = CheckerService(_config(tmp_path, max_inflight=1, max_queue=64))
+    svc._ensure_scheduler = lambda: None
+    try:
+        for _ in range(3):
+            svc.submit("2pc:3")  # 3 batch jobs ahead
+        now = _time.time()
+        with svc._lock:
+            cold = svc._retry_after(svc._counts(), "batch")
+            # Cold pool: the static fallback (3 ahead / 1 slot * half
+            # the default budget), not a measured rate.
+            assert cold == 3 * svc._cfg.default_max_seconds * 0.5
+            svc._drain.append((now - 8.0, "batch"))
+            svc._drain.append((now - 4.0, "batch"))
+            warm = svc._retry_after(svc._counts(), "batch")
+        # Measured: (3 ahead + 1) / (2 completions / ~8 s) ≈ 16 s.
+        assert 14.0 <= warm <= 18.0
+        with svc._lock:
+            # best_effort has no settlements of its own: the pool-wide
+            # rate serves, with ALL 3 batch jobs counted ahead of it.
+            be = svc._retry_after(svc._counts(), "best_effort")
+        assert 14.0 <= be <= 18.0
+    finally:
+        svc.close()
+
+
+def test_mux_partition_respects_class(tmp_path):
+    """Mux groups form WITHIN a priority class ((spec, priority) key):
+    a best_effort lane never rides — and budget-clips — an interactive
+    batch."""
+    svc = CheckerService(_config(
+        tmp_path, max_inflight=0, max_queue=64, mux_k=4,
+    ))
+    svc._ensure_scheduler = lambda: None
+    try:
+        jobs = [
+            svc.submit("2pc:3", priority="interactive") for _ in range(3)
+        ] + [
+            svc.submit("2pc:3", priority="best_effort") for _ in range(3)
+        ]
+        with svc._lock:
+            groups = svc._mux_partition(list(jobs))
+        assert sorted(len(g) for g in groups) == [3, 3]
+        for group in groups:
+            assert len({j.priority for j in group}) == 1
+    finally:
+        svc.close()
+
+
 # --- admission flight-check (stpu-lint --admission at submit) ---------------
 
 _EVIL_FAMILY = '''
@@ -593,6 +803,65 @@ def test_fleet_monitor_idle_exits_and_restarts(tmp_path):
         assert _live_monitors()  # submit brought it back
         assert again.wait(timeout=240)
         assert again.status == "done"
+    finally:
+        fleet.close()
+
+
+def test_elastic_quiesce_wake_exact(tmp_path):
+    """Elastic pools (docs/service.md "QoS & overload"): a quiesced pool
+    leaves routing — work lands on the remaining active pool with counts
+    bit-identical to an undisturbed run — and wakes back into rotation;
+    ``min_active`` refuses to quiesce the last active pool."""
+    fleet = _fleet(tmp_path, devices=2, elastic=True,
+                   idle_quiesce_s=3600.0, min_active=1)
+    try:
+        assert fleet.quiesce_pool(1, reason="test")
+        assert not fleet.quiesce_pool(0, reason="test")  # min_active
+        assert not fleet.quiesce_pool(1, reason="test")  # already parked
+        job = fleet.submit("2pc:3")
+        assert job.device == 0
+        assert fleet.wait_all(timeout=240), fleet.metrics()
+        assert job.status == "done", (job.status, job.error)
+        assert job.migrations == []
+        _assert_exact(job.result, "2pc:3")
+        g = fleet.gauges()
+        assert g["quiesced_devices"] == [1]
+        assert g["pools_quiesced"] == 1
+        assert g["devices"]["device-1"]["quiesced"] is True
+        assert g["devices"]["device-1"]["lost"] is False
+        assert fleet.wake_pool(1, reason="test")
+        g = fleet.gauges()
+        assert g["quiesced_devices"] == []
+        assert g["pools_woken"] == 1
+    finally:
+        fleet.close()
+
+
+def test_elastic_wake_on_pressure(tmp_path):
+    """A submission every active pool rejects WITH a retry hint (pure
+    pressure) wakes a quiesced pool and places there, instead of
+    bouncing the tenant or forcing the host engine. Routing-only
+    (disarmed pools)."""
+    fleet = _fleet(tmp_path, devices=2, elastic=True,
+                   pool_kw={"max_inflight": 0, "max_queue": 1})
+    try:
+        assert fleet.quiesce_pool(1, reason="test")
+        a = fleet.submit("2pc:3")
+        assert a.device == 0
+        # Pool 0 at its shed limit: the hint-carrying rejection wakes
+        # the parked sibling mid-submit.
+        b = fleet.submit("2pc:3")
+        assert b.device == 1
+        g = fleet.gauges()
+        assert g["pools_woken"] == 1
+        assert g["quiesced_devices"] == []
+        # A hint-less rejection (over-cap budget — identical on every
+        # device) must NOT wake anything: waking cannot help.
+        woken_before = fleet.gauges()["pools_woken"]
+        with pytest.raises(AdmissionError) as exc:
+            fleet.submit("2pc:3", max_seconds=10_000_000.0)
+        assert exc.value.retry_after_s is None
+        assert fleet.gauges()["pools_woken"] == woken_before
     finally:
         fleet.close()
 

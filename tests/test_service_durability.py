@@ -39,6 +39,7 @@ import pytest
 
 from stateright_tpu import chaos
 from stateright_tpu.service import (
+    AdmissionError,
     CheckerService,
     FleetConfig,
     FleetService,
@@ -408,6 +409,52 @@ def test_recovery_restores_done_jobs_and_idempotency(tmp_path):
         svc2.close()
 
 
+def test_qos_scheduler_state_replays(tmp_path):
+    """Kill -9 + restart restores the QoS scheduler exactly (ISSUE 18):
+    queued jobs keep tenant/priority/deadline, the per-class fair-share
+    strides fold from replayed ``started`` events, tenant quotas re-arm
+    over the restored queue, and the drain-rate window reseeds from
+    journaled completion timestamps so the first post-restart
+    Retry-After is measured, not cold."""
+    svc = _disarmed(tmp_path, tenant_max_queued=2)
+    vip = svc.submit("2pc:3", tenant="t1", priority="interactive",
+                     deadline_s=90.0)
+    svc.submit("2pc:3", tenant="t1")  # t1's queued quota now full
+    done = svc.submit("2pc:3", tenant="t2", priority="best_effort")
+    with svc._cond:
+        done.status = "running"
+        svc._jlog("started", job=done.id, attempt=0, engine="xla",
+                  resumed_from=None, pid=None)
+        done.status = "done"
+        done.completed_unix_ts = time.time()
+        done.result = {"generated": 1146, "unique": 288, "max_depth": 11,
+                       "seconds": 1.0}
+        svc._counters.inc("jobs_done")
+        svc._jlog("completed", job=done.id, status="done", error=None,
+                  result=done.result)
+    svc.close()
+
+    svc2 = _disarmed(tmp_path, tenant_max_queued=2)
+    try:
+        restored = svc2.job(vip.id)
+        assert restored.status == "queued"
+        assert restored.priority == "interactive"
+        assert restored.tenant == "t1"
+        assert restored.deadline_s == 90.0
+        # Per-class stride state folded from the replayed `started`.
+        assert svc2._qos_served.get("best_effort") == 1
+        # The tenant quota re-arms over the RESTORED queue.
+        with pytest.raises(AdmissionError) as exc:
+            svc2.submit("2pc:3", tenant="t1")
+        assert "queued quota reached" in exc.value.reason
+        assert svc2.gauges()["quota_rejects"] == 1
+        # Drain window reseeded from the journaled completion.
+        assert len(svc2._drain) == 1
+        assert svc2._drain[0][1] == "best_effort"
+    finally:
+        svc2.close()
+
+
 def test_recovery_requeues_inflight_and_charges_budget(tmp_path):
     """An in-flight job requeues on restart with the wall-clock it had
     already spent charged (journal last-ts bounds 'alive until here')."""
@@ -569,23 +616,35 @@ def test_fleet_replay_folds_routes_and_migrations():
         return r
 
     rec("routed", ts=1.0, job="fjob-0001", spec="2pc:3", device=0,
-        pool_job="job-0001", idempotency_key="k1")
+        pool_job="job-0001", idempotency_key="k1",
+        tenant="t9", priority="interactive", deadline_s=120.0)
     rec("routed", ts=1.5, job="fjob-0002", spec="abd:2", device=1,
         pool_job="job-0001", idempotency_key=None)
     rec("migrated", ts=2.0, job="fjob-0001", from_device=0, to_device=1,
         pool_job="job-0002", reason="device-0 lost")
+    rec("quiesced", ts=2.5, device=2, reason="idle")
+    rec("quiesced", ts=2.6, device=1, reason="idle")
+    rec("woken", ts=3.0, device=1, reason="pressure")
     state = _fleet_replay(records)
     assert state["next_id"] == 2
     assert state["routes"]["fjob-0001"] == {
         "device": 1, "pool_job": "job-0002", "spec": "2pc:3",
         "idempotency_key": "k1", "trace_id": None,
+        "tenant": "t9", "priority": "interactive", "deadline_s": 120.0,
     }
     assert state["routes"]["fjob-0002"]["device"] == 1
+    # A pre-QoS record (no tenant/priority) folds to the defaults.
+    assert state["routes"]["fjob-0002"]["tenant"] == "default"
+    assert state["routes"]["fjob-0002"]["priority"] == "batch"
     assert state["idem"] == {"k1": "fjob-0001"}
     assert state["migrations"] == {"fjob-0001": 1}
     assert state["counters"]["routed"] == 2
     assert state["counters"]["migrations"] == 1
     assert state["order"] == ["fjob-0001", "fjob-0002"]
+    # Elastic events fold to the live quiesced set + counters.
+    assert state["quiesced"] == {2}
+    assert state["counters"]["pools_quiesced"] == 2
+    assert state["counters"]["pools_woken"] == 1
 
 
 def _fleet_disarmed(tmp_path, devices=3):

@@ -319,6 +319,33 @@ def judge(
                         slo_fail.append(
                             f"{scen} turnaround p99 {turn}s > {turnaround_p99_s}s"
                         )
+                # Per-class SLO gate (ISSUE 18): present only on
+                # QoS-era chaos lines — each class's p99s ride under
+                # the same ceilings, and an inverted pair (interactive
+                # p99 at or above best_effort's) is a scheduling
+                # regression in its own right.
+                classes = rep.get("classes")
+                if isinstance(classes, dict):
+                    for cls, crow in sorted(classes.items()):
+                        cturn = ((crow or {}).get("turnaround_s")
+                                 or {}).get("p99")
+                        if cturn is None:
+                            continue
+                        slo_detail.append(
+                            f"{scen}/{cls}: turnaround p99 {cturn}s"
+                        )
+                        if cturn > turnaround_p99_s:
+                            slo_fail.append(
+                                f"{scen} {cls} turnaround p99 {cturn}s"
+                                f" > {turnaround_p99_s}s"
+                            )
+                    if rep.get("priority_inversion"):
+                        # The harness only fails the scenario when both
+                        # classes had enough samples; surface the
+                        # low-sample case as detail, not a gate fail.
+                        slo_detail.append(
+                            f"{scen}: priority_inversion flagged"
+                        )
             if not slo_detail:
                 checks.append(
                     _check("slo", "skip", "chaos line carries no percentiles")

@@ -112,23 +112,37 @@ def log(msg: str) -> None:
 # --------------------------------------------------------------------------
 
 
-def build_schedule(seed: int, jobs: int, max_seconds: float) -> Dict[str, Any]:
-    """The seeded submission schedule: pure function of (seed, jobs)."""
+def build_schedule(
+    seed: int, jobs: int, max_seconds: float, tenants: int = 0
+) -> Dict[str, Any]:
+    """The seeded submission schedule: pure function of
+    (seed, jobs, tenants). With ``tenants`` > 0 every entry carries a
+    seeded tenant id and priority class (mixed interactive/batch/
+    best_effort traffic; interactive entries get deadlines) — the QoS
+    tier's load shape (ISSUE 18)."""
     import random
 
     rng = random.Random(seed)
-    return {
-        "seed": seed,
-        "jobs": [
-            {
-                "idem": f"chaos-{seed}-{i}",
-                "spec": rng.choice(SPEC_POOL),
-                "delay_s": round(rng.uniform(0.0, 1.5), 3),
-                "max_seconds": max_seconds,
-            }
-            for i in range(jobs)
-        ],
-    }
+    entries = []
+    for i in range(jobs):
+        entry = {
+            "idem": f"chaos-{seed}-{i}",
+            "spec": rng.choice(SPEC_POOL),
+            "delay_s": round(rng.uniform(0.0, 1.5), 3),
+            "max_seconds": max_seconds,
+        }
+        if tenants:
+            entry["tenant"] = f"t{rng.randrange(tenants)}"
+            draw = rng.random()
+            if draw < 0.3:
+                entry["priority"] = "interactive"
+                entry["deadline_s"] = round(rng.uniform(60.0, 180.0), 3)
+            elif draw < 0.7:
+                entry["priority"] = "batch"
+            else:
+                entry["priority"] = "best_effort"
+        entries.append(entry)
+    return {"seed": seed, "tenants": tenants or None, "jobs": entries}
 
 
 def fault_plan(seed: int, scenario: str) -> Dict[str, Any]:
@@ -152,6 +166,15 @@ def fault_plan(seed: int, scenario: str) -> Dict[str, Any]:
         return {
             "lost_at_route": rng.randint(1, 2),
             "lost_after_s": round(rng.uniform(1.0, 4.0), 3),
+        }
+    if scenario == "storm":
+        # Which scheduled submission triggers the tenant storm, the
+        # burst size, and the mid-storm SIGKILL point (ISSUE 18
+        # acceptance: kill + restart with the storm in flight).
+        return {
+            "storm_at_submit": rng.randint(1, 2),
+            "storm_rate": rng.randint(4, 8),
+            "kill_after_s": round(rng.uniform(2.0, 9.0), 3),
         }
     return {}
 
@@ -229,14 +252,7 @@ def serve(args: argparse.Namespace) -> int:
             if delay > 0:
                 time.sleep(delay)
             t = time.monotonic()
-            job = svc.submit(
-                entry["spec"],
-                max_seconds=entry["max_seconds"],
-                idempotency_key=entry["idem"],
-                # Per-job worker sabotage (the mux scenario arms its
-                # members directly; absent everywhere else).
-                chaos=entry.get("chaos"),
-            )
+            job, retries = _submit_with_retry(svc, entry)
             stats.write(
                 json.dumps(
                     {
@@ -246,12 +262,22 @@ def serve(args: argparse.Namespace) -> int:
                             (time.monotonic() - t) * 1e3, 3
                         ),
                         "deduped": job.recovered,
+                        "priority": entry.get("priority"),
+                        "tenant": entry.get("tenant"),
+                        "admission_retries": retries,
                     }
                 )
                 + "\n"
             )
             stats.flush()
             jobs.append((entry, job))
+            # Seeded tenant storm (chaos point tenant.storm, ISSUE 18):
+            # fires per scheduled submission; admitted burst members
+            # join the waited set (exactly-once audited), shed members
+            # record their typed rejection + hint.
+            storm = _chaos_fire("tenant.storm")
+            if storm is not None:
+                _storm_burst(svc, schedule, storm, stats, jobs)
     retry_stats = (
         _overload_probe(svc, schedule) if args.overload else None
     )
@@ -292,6 +318,82 @@ def serve(args: argparse.Namespace) -> int:
         json.dump(out, fh, indent=1)
     os.replace(tmp, os.path.join(args.run_dir, "driver_results.json"))
     return 0
+
+
+def _chaos_fire(point: str):
+    from stateright_tpu import chaos as chaos_mod
+
+    return chaos_mod.fire(point)
+
+
+def _submit_with_retry(svc, entry: Dict[str, Any], max_tries: int = 30):
+    """Submit one scheduled entry, honoring typed Retry-After rejections
+    (shedding under a storm is the QoS tier WORKING — the scheduled set
+    still has to land eventually for the exactly-once audit). Returns
+    (job, retries). A hint-less rejection (budget/lint) re-raises:
+    retrying it would fail identically."""
+    from stateright_tpu.service import AdmissionError
+
+    tries = 0
+    while True:
+        try:
+            return svc.submit(
+                entry["spec"],
+                max_seconds=entry["max_seconds"],
+                idempotency_key=entry["idem"],
+                # Per-job worker sabotage (the mux scenario arms its
+                # members directly; absent everywhere else).
+                chaos=entry.get("chaos"),
+                tenant=entry.get("tenant", "default"),
+                priority=entry.get("priority", "batch"),
+                deadline_s=entry.get("deadline_s"),
+            ), tries
+        except AdmissionError as e:
+            tries += 1
+            if e.retry_after_s is None or tries >= max_tries:
+                raise
+            time.sleep(min(e.retry_after_s, 5.0))
+
+
+def _storm_burst(svc, schedule, storm, stats, jobs) -> None:
+    """One fired ``tenant.storm``: burst ``rate`` same-tenant
+    submissions in one class through the live service. Deterministic
+    idempotency keys make a restarted incarnation's re-fired storm
+    dedupe onto the journal-replayed jobs instead of double-submitting."""
+    from stateright_tpu.service import AdmissionError
+
+    rate = int(storm.get("rate", 5))
+    tenant = str(storm.get("tenant", "storm"))
+    priority = str(storm.get("class", "best_effort"))
+    first = schedule["jobs"][0]
+    seed = schedule.get("seed", 0)
+    for s in range(rate):
+        idem = f"storm-{seed}-{s}"
+        t = time.monotonic()
+        row: Dict[str, Any] = {
+            "idem": idem, "tenant": tenant, "priority": priority,
+            "storm": True,
+        }
+        try:
+            job = svc.submit(
+                first["spec"],
+                max_seconds=first["max_seconds"],
+                idempotency_key=idem,
+                tenant=tenant,
+                priority=priority,
+            )
+            row.update(
+                job=job.id,
+                latency_ms=round((time.monotonic() - t) * 1e3, 3),
+                deduped=job.recovered,
+            )
+            jobs.append(({"idem": idem, "spec": first["spec"]}, job))
+        except AdmissionError as e:
+            row.update(
+                shed=True, reason=e.reason, retry_after_s=e.retry_after_s
+            )
+        stats.write(json.dumps(row) + "\n")
+        stats.flush()
 
 
 class _SessionChecker:
@@ -427,35 +529,53 @@ def _session_swarm(svc, n: int, run_dir: str) -> _SessionSwarm:
 def _overload_probe(svc, schedule) -> Dict[str, Any]:
     """Retry-After accuracy: push the queue past its cap, record the
     typed hint, retry after (a capped fraction of) it — ``accurate``
-    counts hints that were sufficient."""
+    counts hints that were sufficient. Probed per class: the
+    ``best_effort`` burst hits the QoS tier's shed threshold first
+    (ISSUE 18), so its hint is the measured-drain Retry-After the
+    shedding path computes; the ``batch`` burst reproduces the legacy
+    queue-pressure path. Legacy top-level keys mirror the batch row."""
     from stateright_tpu.service import AdmissionError
 
     spec = schedule["jobs"][0]["spec"]
-    observed = accurate = 0
-    hints: List[float] = []
+    max_seconds = schedule["jobs"][0]["max_seconds"]
     # Queue capacity: the pool cap, or (fleet) the per-device cap summed
     # — the burst must out-size whatever can absorb it.
     cap = getattr(svc._cfg, "max_queue", None)
     if cap is None:
         cap = sum(p._cfg.max_queue for p in svc.pools)
-    for i in range(cap + 2):
-        try:
-            svc.submit(spec, max_seconds=schedule["jobs"][0]["max_seconds"])
-        except AdmissionError as e:
-            if e.retry_after_s is None:
-                continue
-            observed += 1
-            hints.append(e.retry_after_s)
-            time.sleep(min(e.retry_after_s, 15.0))
+    out: Dict[str, Any] = {"classes": {}}
+    for cls in ("best_effort", "batch"):
+        observed = accurate = 0
+        hints: List[float] = []
+        shed = False
+        for i in range(cap + 2):
             try:
-                svc.submit(
-                    spec, max_seconds=schedule["jobs"][0]["max_seconds"]
-                )
-                accurate += 1
-            except AdmissionError:
-                pass
-            break
-    return {"observed": observed, "accurate": accurate, "hints_s": hints}
+                svc.submit(spec, max_seconds=max_seconds, priority=cls)
+            except AdmissionError as e:
+                if e.retry_after_s is None:
+                    continue
+                observed += 1
+                hints.append(e.retry_after_s)
+                shed = "shedding" in (e.reason or "")
+                time.sleep(min(e.retry_after_s, 15.0))
+                try:
+                    svc.submit(
+                        spec, max_seconds=max_seconds, priority=cls
+                    )
+                    accurate += 1
+                except AdmissionError:
+                    pass
+                break
+        out["classes"][cls] = {
+            "observed": observed, "accurate": accurate,
+            "hints_s": hints, "shed": shed,
+        }
+    out.update(
+        observed=out["classes"]["batch"]["observed"],
+        accurate=out["classes"]["batch"]["accurate"],
+        hints_s=out["classes"]["batch"]["hints_s"],
+    )
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -685,16 +805,29 @@ def slo_stats(run_dir: str) -> Dict[str, Any]:
     migrations/losses from the routing journal, per-DEVICE turnaround
     percentiles (ROADMAP 3(c')), and the session-swarm stats."""
     latencies: List[float] = []
+    lat_by_class: Dict[str, List[float]] = {}
+    sheds = 0
     stats_path = os.path.join(run_dir, "admission_stats.jsonl")
     if os.path.exists(stats_path):
         with open(stats_path) as fh:
             for line in fh:
                 try:
-                    latencies.append(json.loads(line)["latency_ms"])
-                except (json.JSONDecodeError, KeyError):
-                    pass
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("shed"):
+                    sheds += 1
+                    continue
+                if "latency_ms" not in row:
+                    continue
+                latencies.append(row["latency_ms"])
+                if row.get("priority"):
+                    lat_by_class.setdefault(row["priority"], []).append(
+                        row["latency_ms"]
+                    )
     fleet = _is_fleet(run_dir)
     submitted: Dict[str, float] = {}
+    priorities: Dict[str, str] = {}
     completed: Dict[str, float] = {}
     per_device: Dict[str, List[float]] = {}
     recovery = None
@@ -703,6 +836,8 @@ def slo_stats(run_dir: str) -> Dict[str, Any]:
         key = f"{r['_device']}:{jid}" if fleet else jid
         if r["event"] == "submitted":
             submitted.setdefault(key, r["ts"])
+            if "priority" in r:
+                priorities[key] = r["priority"] or "batch"
         elif r["event"] == "completed" and r.get("status") == "done":
             completed[key] = r["ts"]
             # Same filter as the aggregate below: a job whose submitted
@@ -728,6 +863,26 @@ def slo_stats(run_dir: str) -> Dict[str, Any]:
         "turnaround_s": _percentiles(turnaround),
         "journal": recovery,
     }
+    # Per-class SLO split (ISSUE 18): present whenever the journal
+    # carries priorities (every post-QoS run; pre-QoS journals skip it,
+    # and bench_regress gates only when the dict exists).
+    if priorities:
+        by_class: Dict[str, List[float]] = {}
+        for j in completed:
+            if j in submitted:
+                by_class.setdefault(
+                    priorities.get(j, "batch"), []
+                ).append(completed[j] - submitted[j])
+        out["classes"] = {
+            cls: {
+                "turnaround_s": _percentiles(by_class.get(cls, [])),
+                "admission_latency_ms": _percentiles(
+                    lat_by_class.get(cls, [])
+                ),
+            }
+            for cls in sorted(set(by_class) | set(lat_by_class))
+        }
+        out["sheds"] = sheds
     if fleet:
         froutes = fleet_journal(run_dir)
         devices = {
@@ -812,6 +967,25 @@ def run_scenario(
         while rc != 0 and restarts < max_restarts:
             restarts += 1
             rc = run_incarnation(run_dir, schedule_path, **kw)
+    elif name == "storm":
+        # Mid-storm SIGKILL + restart (ISSUE 18 acceptance): the storm
+        # chaos rides EVERY incarnation — per-process fire counters make
+        # the restarted storm re-fire at the same submission, and its
+        # deterministic idempotency keys dedupe onto the replayed jobs.
+        storm_chaos = (
+            f"seed={seed};tenant.storm@n={faults['storm_at_submit']}"
+            f":rate={faults['storm_rate']},class=best_effort"
+        )
+        rc = run_incarnation(
+            run_dir, schedule_path,
+            kill_after_s=faults["kill_after_s"],
+            chaos=storm_chaos, **kw,
+        )
+        while rc != 0 and restarts < max_restarts:
+            restarts += 1
+            rc = run_incarnation(
+                run_dir, schedule_path, chaos=storm_chaos, **kw
+            )
     elif name in ("die", "torn"):
         point = "journal.die" if name == "die" else "journal.torn"
         n = faults.get("die_at_record") or faults.get("torn_at_record")
@@ -850,6 +1024,38 @@ def run_scenario(
             report["problems"] = report["problems"] + [
                 "device_lost scenario recorded no migrations"
             ]
+    if name == "storm":
+        # The storm must actually have fired (a pass with no burst
+        # proves nothing), and classes must not invert: interactive p99
+        # turnaround strictly better than best_effort's once both have
+        # enough samples to make the comparison meaningful.
+        stormed = sum(
+            1 for r in journal_history(run_dir)
+            if r["event"] == "submitted" and r.get("tenant") == "storm"
+        )
+        report["storm_submissions"] = stormed
+        if not stormed:
+            report["ok"] = False
+            report["problems"] = report["problems"] + [
+                "storm scenario journaled no storm-tenant submissions"
+            ]
+        classes = report.get("classes") or {}
+        ip99 = ((classes.get("interactive") or {}).get("turnaround_s")
+                or {}).get("p99")
+        bp99 = ((classes.get("best_effort") or {}).get("turnaround_s")
+                or {}).get("p99")
+        i_n = ((classes.get("interactive") or {}).get("turnaround_s")
+               or {}).get("n", 0)
+        b_n = ((classes.get("best_effort") or {}).get("turnaround_s")
+               or {}).get("n", 0)
+        if ip99 is not None and bp99 is not None:
+            report["priority_inversion"] = bool(ip99 >= bp99)
+            if ip99 >= bp99 and min(i_n, b_n) >= 5:
+                report["ok"] = False
+                report["problems"] = report["problems"] + [
+                    f"priority inversion: interactive p99 {ip99:.3f}s >= "
+                    f"best_effort p99 {bp99:.3f}s"
+                ]
     if overload:
         with open(os.path.join(run_dir, "driver_results.json")) as fh:
             report["retry_after"] = json.load(fh).get("retry_after")
@@ -982,7 +1188,10 @@ def reference_counts(run_dir: str, schedule: Dict[str, Any]) -> dict:
 def check_repro(args: argparse.Namespace, base_dir: str) -> Dict[str, Any]:
     """Same seed, twice, fresh dirs, serial pool: the journal event
     sequences (timestamps masked) must be identical."""
-    schedule = build_schedule(args.seed, args.jobs, args.max_seconds)
+    schedule = build_schedule(
+        args.seed, args.jobs, args.max_seconds,
+        tenants=getattr(args, "tenants", 0),
+    )
     sigs = []
     for i in (1, 2):
         run_dir = os.path.join(base_dir, f"repro{i}")
@@ -1013,7 +1222,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--jobs", type=int, default=3)
     p.add_argument("--scenario", default="all",
                    choices=("all", "baseline", "kill", "die", "torn",
-                            "device_lost", "mux"))
+                            "device_lost", "mux", "storm"))
+    p.add_argument("--tenants", type=int, default=0,
+                   help="seeded multi-tenant mixed-priority traffic: "
+                        "every scheduled job gets one of N tenants and "
+                        "a priority class; enables the storm scenario "
+                        "and the per-class SLO split (ISSUE 18)")
     p.add_argument("--fleet", type=int, default=0,
                    help="front N per-device pools (FleetService); 0 = "
                         "the single-pool service")
@@ -1049,11 +1263,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         RUNS, "service_chaos", f"seed{args.seed}"
     )
     os.makedirs(base_dir, exist_ok=True)
-    schedule = build_schedule(args.seed, args.jobs, args.max_seconds)
+    if args.scenario == "storm" and not args.tenants:
+        args.tenants = 12
+    schedule = build_schedule(
+        args.seed, args.jobs, args.max_seconds, tenants=args.tenants
+    )
     line: Dict[str, Any] = {
         "tool": "service_chaos",
         "seed": args.seed,
         "jobs": args.jobs,
+        "tenants": args.tenants or None,
         "fleet_devices": args.fleet or None,
         "sessions": args.sessions or None,
         "mux_k": args.mux or None,
@@ -1073,7 +1292,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif args.scenario == "all":
             names = ["baseline", "kill", "torn"] + (
                 ["device_lost"] if args.fleet else []
-            )
+            ) + (["storm"] if args.tenants else [])
         else:
             names = ["baseline"] + (
                 [args.scenario] if args.scenario != "baseline" else []

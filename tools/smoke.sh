@@ -26,7 +26,11 @@
 # plus a traced 2-job service round merge via obs/collect.py into one
 # Chrome trace: schema valid, monotonic timeline, flow arrows resolve,
 # phases partition their dispatch — the distributed-tracing tier's
-# tier-0 proof).
+# tier-0 proof), and the <30s QOS SHED drill (class-aware admission on a
+# saturated pool: best_effort sheds first with a measured Retry-After,
+# batch sheds at its own threshold, interactive admits until the hard
+# cap, quotas/gauges/deadline validation pinned — the QoS tier's tier-0
+# proof).
 # A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
@@ -55,6 +59,7 @@ exec timeout -k 10 480 python -m pytest \
   tests/test_supervise.py::test_smoke_kill_resume \
   tests/test_service.py::test_smoke_service_kill_resume \
   tests/test_service.py::test_smoke_fleet_failover \
+  tests/test_service.py::test_smoke_qos_shed \
   tests/test_service_durability.py::test_smoke_service_restart_resume \
   tests/test_mux.py::test_smoke_mux \
   tests/test_trace_collect.py::test_smoke_trace_merge \

@@ -87,6 +87,11 @@ fi
 # full-fleet SIGKILL-restart, torn journal, AND a device.lost kill —
 # exactly-once + bit-identical across migrations, per-device SLOs in
 # runs/service_chaos.json's "fleet" dicts.
+# A bare "qos_chaos" expands to the multi-tenant QoS sweep (ISSUE 18):
+# a seeded mixed-priority tenant schedule with the tenant.storm burst,
+# mid-storm SIGKILL + restart, the per-class shed/Retry-After probe —
+# exactly-once, no priority inversion, per-class p50/p99 SLOs in
+# runs/service_chaos.json's "classes" dicts.
 for i in "${!STAGES[@]}"; do
   if [ "${STAGES[$i]}" = "soak_resume" ]; then
     STAGES[$i]="soak_resume,14400,runs/soak_resume.log,python tools/soak.py --config rm10 --audit"
@@ -94,6 +99,8 @@ for i in "${!STAGES[@]}"; do
     STAGES[$i]="service_chaos,1800,runs/service_chaos.log,python tools/service_chaos.py --seed 42 --jobs 3"
   elif [ "${STAGES[$i]}" = "fleet_chaos" ]; then
     STAGES[$i]="fleet_chaos,2400,runs/fleet_chaos.log,python tools/service_chaos.py --seed 42 --jobs 4 --fleet 2 --sessions 4"
+  elif [ "${STAGES[$i]}" = "qos_chaos" ]; then
+    STAGES[$i]="qos_chaos,2400,runs/qos_chaos.log,python tools/service_chaos.py --seed 42 --jobs 6 --tenants 12 --scenario storm --overload"
   elif [ "${STAGES[$i]}" = "bench_regress" ]; then
     # Outfile is a LOG, not runs/regress.json: the stage runner's stdout
     # redirect truncates its outfile at start, which would destroy the
