@@ -39,7 +39,11 @@ frontier widths, per-level seconds, compile vs steady split) for the
 BASELINE.md breakdown. ``BENCH_MUX=K`` adds the batched-scheduling
 throughput probe (K same-spec jobs multiplexed through one
 CheckerService; jobs_per_sec + dispatches_per_job in the detail's
-``mux`` dict — knobs ``BENCH_MUX_SPEC``, ``BENCH_MUX_BUDGET_S``). With ``STPU_TRACE`` set the workers additionally
+``mux`` dict — knobs ``BENCH_MUX_SPEC``, ``BENCH_MUX_BUDGET_S``).
+``BENCH_SYM=1`` adds the symmetry-reduction A/B probe (one shipped spec
+full-space vs symmetry-reduced back to back; class collapse + wall-clock
+ratio + reduced-run audit in the detail's ``sym`` dict — knob
+``BENCH_SYM_SPEC``, docs/symmetry.md). With ``STPU_TRACE`` set the workers additionally
 emit the span JSONL (``tools/roofline.py --measured`` consumes it); the
 trace and heartbeat paths are recorded in ``runs/bench_detail.json``.
 Adding ``STPU_PHASES=1`` turns on the dispatch-phase profiler: the
@@ -528,6 +532,42 @@ def _run_mux_throughput(platform: str) -> dict:
         svc.close()
 
 
+def _run_sym_ab(platform: str) -> dict:
+    """``BENCH_SYM=1``: the symmetry-reduction A/B probe
+    (docs/symmetry.md). One shipped spec (``BENCH_SYM_SPEC``, default
+    2pc:4) runs full-space and symmetry-reduced back to back in this
+    worker on the same engine configuration — reporting the class
+    collapse (unique_full/unique_reduced), the wall-clock ratio (the
+    in-superstep canonicalization network should be ~free against the
+    table sorts it shrinks), and the reduced run's duplicate-key audit.
+    Exactness pins live in tests/test_symmetry.py; this row is the
+    trend line bench_regress watches."""
+    from stateright_tpu.service import registry
+
+    spec = os.environ.get("BENCH_SYM_SPEC", "2pc:4")
+    runs = {}
+    for mode in ("off", "on"):
+        model, caps = registry.resolve(spec)
+        t0 = time.monotonic()
+        checker = model.checker().spawn_xla(symmetry=mode, **caps).join()
+        runs[mode] = (time.monotonic() - t0, checker)
+    off_sec, off_c = runs["off"]
+    on_sec, on_c = runs["on"]
+    full = off_c.unique_state_count()
+    reduced = on_c.unique_state_count()
+    return {
+        "spec": spec,
+        "sym_tag": on_c.metrics().get("symmetry"),
+        "unique_full": full,
+        "unique_reduced": reduced,
+        "collapse": round(full / max(reduced, 1), 3),
+        "off_sec": round(off_sec, 3),
+        "on_sec": round(on_sec, 3),
+        "speedup": round(off_sec / max(on_sec, 1e-9), 3),
+        "audit": _audit(on_c),
+    }
+
+
 def _worker(platform: str) -> None:
     """Child-process body: the actual measurement, on ``platform``. Writes
     bench_detail.json and prints the final JSON line on stdout. The parent
@@ -801,6 +841,7 @@ def _worker(platform: str) -> None:
     phase_summary = _phase_summary(getattr(checker, "phase_log", None))
 
     mux_info = None
+    sym_info = None
 
     def write_detail(matrix):
         os.makedirs(RUNS, exist_ok=True)
@@ -885,6 +926,11 @@ def _worker(platform: str) -> None:
                     # dispatches/job for K same-spec jobs multiplexed
                     # through one service. None unless the knob is set.
                     "mux": mux_info,
+                    # Symmetry-reduction A/B (BENCH_SYM=1;
+                    # docs/symmetry.md): class collapse and wall-clock
+                    # ratio for one spec, full-space vs reduced. None
+                    # unless the knob is set.
+                    "sym": sym_info,
                     "levels": detail,
                     "matrix": matrix,
                 },
@@ -909,6 +955,13 @@ def _worker(platform: str) -> None:
         except Exception as e:  # same contract as the matrix
             _log(f"mux throughput FAILED: {type(e).__name__}: {e}")
             mux_info = {"error": f"{type(e).__name__}: {e}"}
+    if os.environ.get("BENCH_SYM", "0") not in ("", "0"):
+        try:
+            sym_info = _run_sym_ab(platform)
+            _log(f"sym A/B: {sym_info}")
+        except Exception as e:  # same contract as the matrix
+            _log(f"sym A/B FAILED: {type(e).__name__}: {e}")
+            sym_info = {"error": f"{type(e).__name__}: {e}"}
     write_detail(matrix)
 
 

@@ -55,6 +55,7 @@ def plan_for(
     frontier_capacity: Optional[int] = None,
     table_capacity: Optional[int] = None,
     mux_k: Optional[int] = None,
+    symmetry: bool = False,
     _resolved=None,
 ) -> Dict[str, Any]:
     """The compile plan one spec commits to on one platform, at the
@@ -74,7 +75,15 @@ def plan_for(
     ``registry.MUX_FAMILIES``, non-delta dedup); when present, the mux
     programs count toward the same STPU007 budget — batching is opt-in,
     so the default census (and the banked ``runs/compile_plan.json``)
-    stays the solo plan."""
+    stays the solo plan.
+
+    ``symmetry`` adds the symmetry-variant shape classes
+    (docs/symmetry.md): every bucket program recompiles under the
+    canonicalization tag in its cache key when ``STPU_SYMMETRY=1``, so a
+    symmetry-on service doubles the plan. Only models shipping a
+    ``symmetry_spec`` (or ``packed_representative``) get the ``sym``
+    sub-dict; it counts toward the same STPU007 budget, and — like mux —
+    the default census stays the symmetry-off plan."""
     if _resolved is None:
         from ..service.registry import resolve
 
@@ -115,6 +124,24 @@ def plan_for(
         "distinct_programs": len(shapes),
         "budget": int(getattr(model, "xla_compile_budget", MAX_COMPILE_SHAPES)),
     }
+    if symmetry:
+        spec_obj = getattr(model, "symmetry_spec", None)
+        tag = (
+            f"spec:{spec_obj.spec_hash()[:12]}"
+            if spec_obj is not None
+            else (
+                "model:packed_representative"
+                if hasattr(model, "packed_representative")
+                else None
+            )
+        )
+        if tag is not None:
+            plan["sym"] = {
+                "tag": tag,
+                # One symmetry-variant program per solo shape (same
+                # buckets/rungs; the canon kernel fuses into each).
+                "distinct_programs": len(shapes),
+            }
     if mux_k is not None and mux_k > 1:
         from ..service.registry import MUX_FAMILIES, parse
 
@@ -131,7 +158,9 @@ def plan_for(
 
 
 def build_census(
-    specs: Optional[List[str]] = None, mux_k: Optional[int] = None
+    specs: Optional[List[str]] = None,
+    mux_k: Optional[int] = None,
+    symmetry: bool = False,
 ) -> Dict[str, Any]:
     """The full census: every shipped spec's plan on both platforms.
     Callers that may touch a fresh jax process (``tools/warm_cache.py``'s
@@ -144,7 +173,9 @@ def build_census(
     for spec in specs if specs is not None else list(SHIPPED):
         resolved = resolve(spec)
         out["specs"][spec] = {
-            p: plan_for(spec, p, mux_k=mux_k, _resolved=resolved)
+            p: plan_for(
+                spec, p, mux_k=mux_k, symmetry=symmetry, _resolved=resolved
+            )
             for p in PLATFORMS
         }
     return out
@@ -159,8 +190,10 @@ def census_findings(census: Dict[str, Any]) -> List[Finding]:
             # A mux-enabled census prices the TOTAL a batching service
             # compiles: the solo plan plus one batched program per
             # bucket at lane count K.
-            n = plan["distinct_programs"] + plan.get("mux", {}).get(
-                "distinct_programs", 0
+            n = (
+                plan["distinct_programs"]
+                + plan.get("mux", {}).get("distinct_programs", 0)
+                + plan.get("sym", {}).get("distinct_programs", 0)
             )
             budget = plan["budget"]
             if n <= budget:

@@ -214,6 +214,24 @@ def _kernel_surfaces(spec: str, model) -> List[Tuple[str, Callable[[], List[Find
                 ),
             )
         )
+    if getattr(model, "symmetry_spec", None) is not None:
+        # The spec-compiled canonicalization kernel (stateright_tpu/sym;
+        # docs/symmetry.md): fingerprinting vmaps it over every frontier
+        # row when symmetry is on, so it takes the same vmapped-kernel
+        # rules as the model's own transition kernels.
+        name = f"kernel:{spec}:sym-canon"
+
+        def run_sym(name=name, spec_obj=model.symmetry_spec):
+            from ..sym import compile_canon
+
+            jx = _trace(jax.vmap(compile_canon(spec_obj)), rows)
+            return (
+                taint_scatters(jx, name)
+                + output_transposes(jx, name)
+                + wide_sorts(jx, name)
+            )
+
+        out.append((name, run_sym))
 
     # The STPU_EXPAND_LAYOUT=planes A/B variant: vmap emits [A, W, F]
     # directly (out_axes=2) — the transpose-fused-into-vmap shape. Kept
@@ -242,6 +260,30 @@ def _lowering_surface(spec: str, model) -> Tuple[str, Callable[[], List[Finding]
         jax, jnp = _jnp()
         rows = _sds((KERNEL_BATCH, model.state_words), jnp.uint32)
         fn = jax.vmap(model.packed_step)
+        inv = {}
+        for platform in ("cpu", "tpu"):
+            lowered = jax.jit(fn).trace(rows).lower(
+                lowering_platforms=(platform,)
+            )
+            inv[platform] = op_inventory(lowered.as_text())
+        return diff_lowering_inventories(name, inv["cpu"], inv["tpu"])
+
+    return name, run
+
+
+def _sym_lowering_surface(spec: str, model) -> Tuple[str, Callable[[], List[Finding]]]:
+    """STPU008 for the spec-compiled canonicalization kernel: diff its
+    cpu/tpu StableHLO op inventories the same way the transition kernel
+    is diffed — the canon kernel rides every symmetry-on dispatch, so a
+    one-sided pathology op there is the same structural miscompile class."""
+    name = f"lower:{spec}:sym-canon"
+
+    def run():
+        jax, jnp = _jnp()
+        from ..sym import compile_canon
+
+        rows = _sds((KERNEL_BATCH, model.state_words), jnp.uint32)
+        fn = jax.vmap(compile_canon(model.symmetry_spec))
         inv = {}
         for platform in ("cpu", "tpu"):
             lowered = jax.jit(fn).trace(rows).lower(
@@ -670,6 +712,8 @@ def build_sweep(full: bool = False) -> List[Tuple[str, Callable[[], List[Finding
         # admitted spec (build_admission_sweep).
         if full or spec in MATRIX_SPECS:
             out.append(_lowering_surface(spec, model))
+            if getattr(model, "symmetry_spec", None) is not None:
+                out.append(_sym_lowering_surface(spec, model))
     # Fused multi-level programs (the lax.switch ladder + while loop):
     # one narrow sorted, one narrow delta (STPU004's switch-carrying
     # delta program), one wide sorted under --full.
@@ -701,6 +745,8 @@ def build_admission_sweep(
     model, _ = resolve(spec)
     out = _kernel_surfaces(spec, model)
     out.append(_lowering_surface(spec, model))
+    if getattr(model, "symmetry_spec", None) is not None:
+        out.append(_sym_lowering_surface(spec, model))
     out.append(_census_surface([spec]))
     return out
 

@@ -160,6 +160,13 @@ def save_checkpoint(checker, path: str, keep: int = 1) -> None:
         "state_words": checker._W,
         "max_actions": checker._A,
         "property_names": checker._prop_names,
+        # Symmetry identity (stateright_tpu/sym, docs/symmetry.md): the
+        # resolved tag — None (off), "spec:<hash12>" (the spec-compiled
+        # kernel), or "model:packed_representative". A resume into a
+        # DIFFERENT canonicalization would dedup new states against a
+        # differently-keyed table, silently corrupting counts, so
+        # validate_symmetry fails such resumes typed.
+        "symmetry": getattr(checker, "_sym_tag", None),
         "depth": checker._depth,
         "max_depth": checker._max_depth,
         "state_count": checker._state_count,
@@ -338,6 +345,26 @@ def validate_model(meta: Dict[str, Any], model, prop_names) -> None:
     if problems:
         raise ValueError(
             "checkpoint does not match this model: " + "; ".join(problems)
+        )
+
+
+def validate_symmetry(meta: Dict[str, Any], sym_tag) -> None:
+    """A checkpoint is only loadable into a checker with the SAME
+    canonicalization identity (``_sym_tag``): the visited table's keys
+    are fingerprints of canonical forms, so resuming under a different
+    symmetry config (off vs on, or a changed spec) would silently
+    mis-dedup every state inserted after the resume. Checkpoints written
+    before the symmetry tier lack the key and skip this check (they
+    predate spec kernels, so their canonicalization matches whatever the
+    model's packed_representative still computes)."""
+    if "symmetry" not in meta:
+        return
+    if meta["symmetry"] != sym_tag:
+        raise ValueError(
+            f"checkpoint symmetry mismatch: written with "
+            f"{meta['symmetry']!r}, resuming with {sym_tag!r} — a resume "
+            f"must keep the same spawn_xla(symmetry=)/STPU_SYMMETRY "
+            f"config (and spec) the checkpoint was written under"
         )
 
 

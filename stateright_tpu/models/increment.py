@@ -98,6 +98,17 @@ class PackedIncrement(Increment):
         )
         self.state_words = self._layout.words
         self.max_actions = n
+        if n >= 2:
+            # Declarative device symmetry (stateright_tpu/sym): thread
+            # block k = its (t, pc) layout elements; both lanes key the
+            # sort, so the spec kernel equals packed_representative
+            # bit-for-bit (the (t, pc) pair IS the whole block — the
+            # hand-written sort was already a full canonicalization).
+            from ..sym import SymmetrySpec
+
+            self.symmetry_spec = SymmetrySpec.from_layout(
+                self._layout, ["t", "pc"], group="threads", name="increment"
+            )
 
     # --- host codec --------------------------------------------------------
 

@@ -107,6 +107,17 @@ class PackedIncrementLock(IncrementLock):
         )
         self.state_words = self._layout.words
         self.max_actions = n
+        if n >= 2:
+            # Declarative device symmetry (stateright_tpu/sym): same
+            # thread-block declaration as PackedIncrement — (t, pc) is
+            # the whole block, so the spec kernel matches
+            # packed_representative bit-for-bit.
+            from ..sym import SymmetrySpec
+
+            self.symmetry_spec = SymmetrySpec.from_layout(
+                self._layout, ["t", "pc"], group="threads",
+                name="increment-lock",
+            )
 
     def pack(self, state: IncrementLockState):
         return self._layout.pack(

@@ -171,6 +171,40 @@ class PackedTwoPhaseSys(TwoPhaseSys):
             raise ValueError("PackedTwoPhaseSys supports rm_count <= 14")
         super().__init__(rm_count)
         self.max_actions = 2 + 5 * rm_count
+        if rm_count >= 2:
+            # Declarative device symmetry (stateright_tpu/sym,
+            # docs/symmetry.md): RM block i = its rm_state dibit, its
+            # tm_prepared bit, and its Prepared{i} message bit. All three
+            # lanes key the sort, so the spec kernel is a FULL (class-
+            # invariant) canonicalization — unlike the partial rm_state
+            # sort of :meth:`packed_representative`, its reduced counts
+            # are traversal-order-independent (rm=5: 314 classes on any
+            # engine; the partial form visits 665 under the reference
+            # DFS and 508 under the device BFS).
+            from ..sym import BlockGroup, SymmetrySpec
+
+            self.symmetry_spec = SymmetrySpec(
+                [
+                    BlockGroup(
+                        "rm",
+                        rm_count,
+                        (
+                            SymmetrySpec.lane(
+                                "rm_state", 2, word=0, count=rm_count
+                            ),
+                            SymmetrySpec.lane(
+                                "tm_prepared", 1, word=1, shift0=2,
+                                stride=1, count=rm_count,
+                            ),
+                            SymmetrySpec.lane(
+                                "prepared_msg", 1, word=1, shift0=16,
+                                stride=1, count=rm_count,
+                            ),
+                        ),
+                    )
+                ],
+                name="2pc-rm",
+            )
 
     # --- host-side codec --------------------------------------------------
 

@@ -80,6 +80,7 @@ class ShardedXlaChecker(Checker):
         checkpoint_every: Any = None,
         checkpoint_keep: Optional[int] = None,
         dedup: str = "auto",
+        symmetry=None,
         host_verified_cap: int = 128,
         trace=None,
         heartbeat=None,
@@ -98,11 +99,25 @@ class ShardedXlaChecker(Checker):
         self._D = mesh.devices.size
         if self._D & (self._D - 1):
             raise ValueError(f"mesh size must be a power of two, got {self._D}")
-        self._symmetry = builder._symmetry is not None
-        if self._symmetry and not hasattr(model, "packed_representative"):
-            raise TypeError(
-                f"symmetry reduction under spawn_xla() requires "
-                f"{type(model).__name__}.packed_representative"
+        # Symmetry reduction (stateright_tpu/sym, docs/symmetry.md): the
+        # same resolution as the single-chip engine — shard ROUTING hashes
+        # the canonical form too (owner bits come from the representative
+        # fingerprint), so one class never splits across shards.
+        from ..sym import SymmetryUnsupported, resolve_symmetry
+
+        _sym = resolve_symmetry(
+            symmetry, builder._symmetry is not None, model, engine="xla-mesh"
+        )
+        self._symmetry = _sym.enabled
+        self._sym_tag = _sym.tag
+        self._sym_canon = _sym.device_canon
+        self._sym_canon_host = _sym.host_canon
+        if self._symmetry and getattr(model, "host_verified_properties", ()):
+            raise SymmetryUnsupported(
+                "xla-mesh",
+                f"{type(model).__name__} declares host_verified_properties; "
+                f"the host-verified fallback evaluates concrete states and "
+                f"cannot honor a symmetry-reduced frontier",
             )
         self._target_state_count = builder._target_state_count
         self._target_max_depth = builder._target_max_depth
@@ -312,10 +327,11 @@ class ShardedXlaChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        from ..checkpoint import load_checkpoint, validate_model
+        from ..checkpoint import load_checkpoint, validate_model, validate_symmetry
 
         ck = load_checkpoint(path)
         validate_model(ck["meta"], self._model, self._prop_names)
+        validate_symmetry(ck["meta"], self._sym_tag)
         D = self._D
 
         # Visited set: distribute entries by owner, then bulk-insert.
@@ -611,8 +627,10 @@ class ShardedXlaChecker(Checker):
         local_table = self._local_table
         local_table_out = self._local_table_out
 
+        sym_canon = self._sym_canon
+
         def dedup_words(words):
-            return model.packed_representative(words) if symmetry else words
+            return sym_canon(words) if symmetry else words
 
         def pick_discovery(disc_found, disc_fp, i, viol, fhi, flo):
             """Elect one witness fingerprint across shards: the local first
@@ -1712,6 +1730,7 @@ class ShardedXlaChecker(Checker):
             # -- configuration gauges ---------------------------------
             "dedup": self._dedup,
             "compaction": "mesh",
+            "symmetry": self._sym_tag,
             "ladder": "none",
             "cand_ladder_k": 1,
             "shrink_exit": False,

@@ -336,6 +336,7 @@ class Job:
         tenant: str = "default",
         priority: str = "batch",
         deadline_s: Optional[float] = None,
+        symmetry: Optional[str] = None,
     ):
         self._service = service
         self.id = job_id
@@ -350,6 +351,13 @@ class Job:
         self.tenant = tenant
         self.priority = priority
         self.deadline_s = deadline_s
+        #: Per-job symmetry-reduction mode (docs/symmetry.md): None
+        #: inherits the pool's environment (STPU_SYMMETRY), "on"/"off"/
+        #: "auto" override it for this job's workers. Journaled on
+        #: ``submitted`` so replay and migration keep the mode — a
+        #: resumed attempt under a different mode would fail the
+        #: checkpoint's symmetry-identity check (checkpoint.py).
+        self.symmetry = symmetry
         #: queued|running|quarantined|done|failed|migrated — "migrated" is
         #: terminal FOR THIS POOL: the fleet evacuated the job to a
         #: sibling device (service/fleet.py), which owns it from then on.
@@ -469,6 +477,7 @@ class Job:
             "tenant": self.tenant,
             "priority": self.priority,
             "deadline_s": self.deadline_s,
+            "symmetry": self.symmetry,
             # The device this pool serves (fleet pools; None on the
             # single-device pool) — the dashboard's per-device grouping.
             "device": self._service._cfg.device,
@@ -548,6 +557,7 @@ class Job:
             "tenant": self.tenant,
             "priority": self.priority,
             "deadline_s": self.deadline_s,
+            "symmetry": self.symmetry,
         }
 
     def metrics(self) -> Optional[Dict[str, Any]]:
@@ -670,6 +680,7 @@ def _replay_state(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "tenant": rec.get("tenant", "default"),
                 "priority": rec.get("priority", "batch"),
                 "deadline_s": rec.get("deadline_s"),
+                "symmetry": rec.get("symmetry"),
             }
             state["jobs"][jid] = job
             state["order"].append(jid)
@@ -1014,6 +1025,7 @@ class CheckerService:
                     tenant=rec.get("tenant", "default"),
                     priority=rec.get("priority", "batch"),
                     deadline_s=rec.get("deadline_s"),
+                    symmetry=rec.get("symmetry"),
                 )
                 job.recovered = True
                 job.created_unix_ts = rec.get("created_unix_ts", now)
@@ -1521,6 +1533,7 @@ class CheckerService:
         tenant: str = "default",
         priority: str = "batch",
         deadline_s: Optional[float] = None,
+        symmetry: Optional[str] = None,
     ) -> Job:
         """Queues one batch checking job; returns its :class:`Job` handle
         or raises :class:`AdmissionError` (queue full → carries
@@ -1570,6 +1583,10 @@ class CheckerService:
             raise ValueError(f"tenant must be a non-empty string, got {tenant!r}")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s!r}")
+        if symmetry is not None and symmetry not in ("auto", "on", "off"):
+            raise ValueError(
+                f"symmetry must be None/'auto'/'on'/'off', got {symmetry!r}"
+            )
         family, _ = registry.parse(spec)  # typed spec validation, pre-admission
         _t0 = time.monotonic()
         with self._lock:
@@ -1701,6 +1718,7 @@ class CheckerService:
                 tenant=tenant,
                 priority=priority,
                 deadline_s=deadline_s,
+                symmetry=symmetry,
             )
             job.lint = lint
             job.engine_force = "host" if engine == "host" else None
@@ -1752,6 +1770,7 @@ class CheckerService:
                 tenant=tenant,
                 priority=priority,
                 deadline_s=deadline_s,
+                symmetry=symmetry,
             )
             self._jlog(
                 "admitted",
@@ -2046,7 +2065,9 @@ class CheckerService:
         (``_mux_solo``). Migration seeds (``seed_checkpoint``) stay solo
         too: a migrated-in job's adopted rotation can arrive at grown
         capacities the fresh sibling lanes don't share. Groups form
-        WITHIN a priority class ((spec, priority) key): the group budget
+        WITHIN a priority class and symmetry mode ((spec, priority,
+        symmetry) key — lanes must agree on the canonicalization tag,
+        xla_mux._check_lanes): the group budget
         is the tightest member's, and batching across classes would let
         a best-effort lane ride — and clip — an interactive dispatch's
         budget (docs/service.md "QoS & overload")."""
@@ -2068,7 +2089,9 @@ class CheckerService:
         by_spec: Dict[Any, List[Job]] = {}
         for job in to_start:
             if eligible(job):
-                by_spec.setdefault((job.spec, job.priority), []).append(job)
+                by_spec.setdefault(
+                    (job.spec, job.priority, job.symmetry), []
+                ).append(job)
             else:
                 groups.append([job])
         for members in by_spec.values():
@@ -2090,6 +2113,10 @@ class CheckerService:
             env.pop(key, None)
         if device:
             env["STPU_TRACE"] = job.trace_path
+        if job.symmetry is not None:
+            # The per-job mode beats the pool's inherited STPU_SYMMETRY
+            # (None inherits — symmetry is a plain env knob otherwise).
+            env["STPU_SYMMETRY"] = job.symmetry
         env["STPU_COMPILE_CACHE"] = self._cfg.compile_cache
         if self._cfg.chaos:
             # The config's chaos plan rides into every worker (each

@@ -157,6 +157,9 @@ class FleetJob:
         self.tenant: str = "default"
         self.priority: str = "batch"
         self.deadline_s: Optional[float] = None
+        #: Per-job symmetry mode (docs/symmetry.md) — journaled on
+        #: ``routed`` so migrations and orphan re-routes keep it.
+        self.symmetry: Optional[str] = None
         self.created_unix_ts = time.time()
         #: Fleet-minted distributed-trace id — stable across migrations
         #: (every hop's pool job carries the same one).
@@ -313,6 +316,7 @@ def _fleet_replay(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "tenant": rec.get("tenant", "default"),
                 "priority": rec.get("priority", "batch"),
                 "deadline_s": rec.get("deadline_s"),
+                "symmetry": rec.get("symmetry"),
             }
             if fid not in state["order"]:
                 state["order"].append(fid)
@@ -567,6 +571,7 @@ class FleetService:
                 fjob.tenant = route.get("tenant", "default")
                 fjob.priority = route.get("priority", "batch")
                 fjob.deadline_s = route.get("deadline_s")
+                fjob.symmetry = route.get("symmetry")
                 fjob.migrations = [
                     {"recovered": True}
                 ] * state["migrations"].get(fid, 0)
@@ -803,6 +808,7 @@ class FleetService:
         tenant: str = "default",
         priority: str = "batch",
         deadline_s: Optional[float] = None,
+        symmetry: Optional[str] = None,
     ) -> FleetJob:
         """Route one batch job to the least-loaded healthy device —
         class-aware: same-class backlog counts double, so a class's
@@ -838,6 +844,7 @@ class FleetService:
             fjob.tenant = tenant
             fjob.priority = priority
             fjob.deadline_s = deadline_s
+            fjob.symmetry = symmetry
             self._jobs[fjob.id] = fjob
             self._order.append(fjob.id)
             if idempotency_key is not None:
@@ -876,6 +883,7 @@ class FleetService:
                         tenant=tenant,
                         priority=priority,
                         deadline_s=deadline_s,
+                        symmetry=symmetry,
                     )
                     device = i
                     break
@@ -905,6 +913,7 @@ class FleetService:
                             tenant=tenant,
                             priority=priority,
                             deadline_s=deadline_s,
+                            symmetry=symmetry,
                         )
                         device = woken
                     except AdmissionError as e:
@@ -930,6 +939,7 @@ class FleetService:
                         tenant=tenant,
                         priority=priority,
                         deadline_s=deadline_s,
+                        symmetry=symmetry,
                     )
                     device = alive[0]
                     forced_host = True
@@ -978,6 +988,7 @@ class FleetService:
                 host=forced_host or None,
                 trace_id=fjob.trace_id,
                 tenant=tenant, priority=priority, deadline_s=deadline_s,
+                symmetry=symmetry,
             )
             landed_lost = device in self._lost
         if self._tracer.enabled:
@@ -1105,6 +1116,7 @@ class FleetService:
                     tenant=old.tenant,
                     priority=old.priority,
                     deadline_s=old.deadline_s,
+                    symmetry=old.symmetry,
                 )
                 reason = old.error
                 requeues = old.requeues
@@ -1125,6 +1137,7 @@ class FleetService:
                     tenant=fjob.tenant,
                     priority=fjob.priority,
                     deadline_s=fjob.deadline_s,
+                    symmetry=fjob.symmetry,
                 )
                 if fjob.trace_id:
                     resume_kwargs["trace_id"] = fjob.trace_id

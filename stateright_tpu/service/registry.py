@@ -110,6 +110,15 @@ FAMILIES: Dict[str, Callable[[List[int]], Tuple[Any, Dict[str, int]]]] = {
 MUX_FAMILIES = frozenset(FAMILIES) - {"scr"}
 
 
+#: Families whose packed models ship a declarative ``symmetry_spec``
+#: (stateright_tpu/sym; docs/symmetry.md) — the set ``tools/warm_cache.py
+#: --sym`` pre-banks symmetry-variant programs for, statically (like
+#: MUX_FAMILIES: no model import in the jax-free parent). Drift against
+#: the models' actual capability is a test failure
+#: (tests/test_symmetry.py).
+SYM_FAMILIES = frozenset({"2pc", "increment", "increment-lock"})
+
+
 def _extra_family_targets() -> Dict[str, Tuple[str, str]]:
     """The ``STPU_FAMILIES="name=module:attr,..."`` mapping, parsed but
     NOT imported — :func:`parse` validates spec names against this

@@ -1,0 +1,46 @@
+"""Device-side symmetry reduction (docs/symmetry.md).
+
+The host tier reduces symmetric state spaces through object-level
+``representative()`` methods (``checker/builder.py symmetry()``,
+``utils/rewrite_plan.py`` — the reference's ``representative.rs`` /
+``rewrite_plan.rs``). This package is the packed-tier analogue: a
+declarative per-model :class:`SymmetrySpec` names the role-symmetric
+process blocks in the packed word layout (field group, block count,
+block bit-width — the same declaration style ``packing.py`` uses for
+fields), and :func:`compile_canon` compiles it into a fixed, vmapped,
+**scatter-free** canonicalization kernel — a stable odd-even
+transposition sorting network over block keys whose conditional block
+swaps are pure ``jnp.where`` selects, reassembled into words via
+``packing._word_update`` at static indices (STPU001-clean by
+construction: no data-dependent scatter, no gather, rows-in layout,
+no fused transpose).
+
+The kernel is applied to each frontier row immediately before
+fingerprinting in both device engines (``xla.py`` — inside the fused
+superstep, zero extra dispatches — and ``checker/device_on_demand.py``)
+and in the sharded mesh superstep (shard routing hashes the
+representative). Because every lane of a block participates in the sort
+key, the canonical form is a PERFECT (class-invariant) canonicalizer:
+visited-representative counts are traversal-order-independent and
+bit-equal across engines and dedup backends.
+
+Surface: ``spawn_xla(symmetry=)`` / ``STPU_SYMMETRY`` (see
+:func:`resolve_symmetry`); paths that cannot honor an enabled symmetry
+raise :class:`SymmetryUnsupported` instead of silently exploring the
+full space.
+"""
+
+from .spec import BlockGroup, Lane, SymmetrySpec, SymmetryUnsupported
+from .kernel import canonicalize_host, compile_canon, object_canonicalizer
+from .resolve import resolve_symmetry
+
+__all__ = [
+    "BlockGroup",
+    "Lane",
+    "SymmetrySpec",
+    "SymmetryUnsupported",
+    "canonicalize_host",
+    "compile_canon",
+    "object_canonicalizer",
+    "resolve_symmetry",
+]

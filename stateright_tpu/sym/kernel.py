@@ -1,0 +1,162 @@
+"""Spec-compiled canonicalization kernels (device + bit-exact host twin).
+
+:func:`compile_canon` turns a :class:`~stateright_tpu.sym.spec.SymmetrySpec`
+into a ``words[W] -> words[W]`` function the engines vmap over frontier
+rows right before fingerprinting. The kernel is a stable odd-even
+transposition sorting network over the group's blocks:
+
+- block keys compare lexicographically over the lanes in declaration
+  order; a comparator swaps only on STRICT greater-than, which makes the
+  adjacent-transposition network a stable sort — bit-identical to the
+  host twin's ``sorted(..., key=block_tuple)``;
+- a comparator's conditional swap is a pure ``jnp.where`` select over
+  the per-lane value vectors (no gather, no scatter — the op class every
+  backend lowers reliably, see ``packing._word_update``'s docstring for
+  the pinned TPU scatter-drop miscompile this family of kernels must
+  avoid);
+- reassembly clears each touched word's group bits with a static mask
+  and ORs the sorted lane values back at their static shifts, writing
+  the word through ``packing._word_update`` at a static index (folds to
+  a static update; STPU001's static-index exemption).
+
+Network cost is ``count*(count-1)/2`` comparators per group — counts
+here are process counts (<= ~8), so the whole canonicalization fuses
+into the superstep for free against the table-scale sorts it shrinks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import numpy as np
+
+from .spec import SymmetrySpec, SymmetryUnsupported
+
+
+def _comparator_rounds(count: int) -> List[List[int]]:
+    """Odd-even transposition schedule: ``count`` rounds of adjacent
+    comparator columns (round r compares (i, i+1) for i = r%2, r%2+2, …).
+    Returns the left index of each comparator, per round."""
+    return [list(range(r % 2, count - 1, 2)) for r in range(count)]
+
+
+def compile_canon(spec: SymmetrySpec) -> Callable[[Any], Any]:
+    """The device kernel: ``canon(words[W]) -> words[W]`` (jnp, traceable,
+    vmapped by the engines over frontier rows)."""
+
+    def canon(words):
+        import jax.numpy as jnp
+
+        from ..packing import _word_update
+
+        for g in spec.groups:
+            n = g.count
+            # Extract: one [n] uint32 vector per lane, static shifts/masks.
+            vals = []
+            for lane in g.lanes:
+                mask = jnp.uint32((1 << lane.bits) - 1)
+                vals.append(
+                    jnp.stack(
+                        [
+                            (words[w] >> jnp.uint32(s)) & mask
+                            for w, s in lane.positions
+                        ]
+                    )
+                )
+            # Stable odd-even transposition network: swap on STRICT
+            # lexicographic greater-than over the lanes.
+            for comparators in _comparator_rounds(n):
+                for i in comparators:
+                    gt = jnp.bool_(False)
+                    eq = jnp.bool_(True)
+                    for v in vals:
+                        a, b = v[i], v[i + 1]
+                        gt = gt | (eq & (a > b))
+                        eq = eq & (a == b)
+                    new_vals = []
+                    for v in vals:
+                        a, b = v[i], v[i + 1]
+                        lo = jnp.where(gt, b, a)
+                        hi = jnp.where(gt, a, b)
+                        v = _word_update(v, i, lo)
+                        v = _word_update(v, i + 1, hi)
+                        new_vals.append(v)
+                    vals = new_vals
+            # Reassemble: clear the group's bits per touched word (static
+            # mask), OR the sorted lane values back at static shifts.
+            clear: dict = {}
+            contrib: dict = {}
+            for lane, v in zip(g.lanes, vals):
+                lane_mask = (1 << lane.bits) - 1
+                for b, (w, s) in enumerate(lane.positions):
+                    clear[w] = clear.get(w, 0) | (lane_mask << s)
+                    contrib.setdefault(w, []).append(v[b] << jnp.uint32(s))
+            for w in sorted(clear):
+                acc = words[w] & jnp.uint32(~clear[w] & 0xFFFFFFFF)
+                for piece in contrib[w]:
+                    acc = acc | piece
+                words = _word_update(words, w, acc)
+        return words
+
+    return canon
+
+
+def canonicalize_host(spec: SymmetrySpec, row: np.ndarray) -> np.ndarray:
+    """Bit-exact numpy twin of :func:`compile_canon` for one packed row —
+    the engines' host-side fingerprint path and the differential tests'
+    oracle. A stable sort by the full block key tuple equals the strict
+    greater-than adjacent-transposition network exactly."""
+    out = np.array(row, dtype=np.uint32, copy=True)
+    for g in spec.groups:
+        n = g.count
+        blocks = []
+        for b in range(n):
+            key = tuple(
+                (int(out[w]) >> s) & ((1 << lane.bits) - 1)
+                for lane in g.lanes
+                for w, s in [lane.positions[b]]
+            )
+            blocks.append(key)
+        order = sorted(range(n), key=lambda b: blocks[b])
+        for li, lane in enumerate(g.lanes):
+            lane_mask = (1 << lane.bits) - 1
+            vals = [blocks[b][li] for b in range(n)]
+            for new_b, old_b in enumerate(order):
+                w, s = lane.positions[new_b]
+                out[w] = np.uint32(
+                    (int(out[w]) & ~(lane_mask << s)) | (vals[old_b] << s)
+                )
+    return out
+
+
+def host_canonicalizer(spec: SymmetrySpec) -> Callable[[np.ndarray], np.ndarray]:
+    """Partial application of :func:`canonicalize_host` (the form the
+    engines store next to the device kernel)."""
+
+    def canon(row: np.ndarray) -> np.ndarray:
+        return canonicalize_host(spec, row)
+
+    return canon
+
+
+def object_canonicalizer(model) -> Callable[[Any], Any]:
+    """An OBJECT-state canonicalizer for the host search engines, derived
+    from the model's spec through its own pack/unpack codec — the host
+    symmetry oracle the device engines are differentially tested against:
+
+        host = Model(...).checker().symmetry_fn(object_canonicalizer(m))
+
+    explores exactly the classes ``spawn_xla`` + spec symmetry visits
+    (class-invariant canon => traversal-order-independent counts)."""
+    spec = getattr(model, "symmetry_spec", None)
+    if spec is None:
+        raise SymmetryUnsupported(
+            "object_canonicalizer",
+            f"{type(model).__name__} ships no symmetry_spec",
+        )
+
+    def canon(state):
+        row = np.asarray(model.pack(state), dtype=np.uint32)
+        return model.unpack(canonicalize_host(spec, row))
+
+    return canon

@@ -63,7 +63,13 @@ def rewrite(value: Any, plan: RewritePlan) -> Any:
     (the generic analogue of rewrite.rs's blanket impls: no-op for scalars,
     structural recursion for containers, ``__rewrite__`` for custom types).
     Unknown structured types raise rather than silently passing through —
-    a missed Id remap would make symmetry reduction unsound."""
+    a missed Id remap would make symmetry reduction unsound — and the
+    error NAMES THE PATH to the offending value (``state.msgs[2].src``),
+    not just its type, so a model author can find the field to fix."""
+    return _rewrite(value, plan, "state")
+
+
+def _rewrite(value: Any, plan: RewritePlan, path: str) -> Any:
     import dataclasses
     from enum import Enum
 
@@ -77,28 +83,62 @@ def rewrite(value: Any, plan: RewritePlan) -> Any:
         return custom(plan)
     if isinstance(value, Envelope):
         return Envelope(
-            rewrite(value.src, plan), rewrite(value.dst, plan), rewrite(value.msg, plan)
+            _rewrite(value.src, plan, f"{path}.src"),
+            _rewrite(value.dst, plan, f"{path}.dst"),
+            _rewrite(value.msg, plan, f"{path}.msg"),
         )
     t = type(value)
-    if t is tuple or (isinstance(value, tuple) and hasattr(value, "_fields")):
-        items = [rewrite(v, plan) for v in value]
-        return t(*items) if hasattr(value, "_fields") else tuple(items)
+    if isinstance(value, tuple) and hasattr(value, "_fields"):
+        return t(*(
+            _rewrite(v, plan, f"{path}.{name}")
+            for name, v in zip(value._fields, value)
+        ))
+    if t is tuple:
+        return tuple(
+            _rewrite(v, plan, f"{path}[{i}]") for i, v in enumerate(value)
+        )
     if t is list:
-        return [rewrite(v, plan) for v in value]
+        return [_rewrite(v, plan, f"{path}[{i}]") for i, v in enumerate(value)]
     if t in (set, frozenset):
-        return t(rewrite(v, plan) for v in value)
-    if t is dict:
-        return {rewrite(k, plan): rewrite(v, plan) for k, v in value.items()}
+        return t(_rewrite(v, plan, f"{path}{{…}}") for v in value)
+    if isinstance(value, DenseNatMap):
+        # Index-keyed by construction (actor/process ids): the plan
+        # permutes the ENTRIES too, not just embedded Ids — the
+        # reference's Rewrite impl reindexes (rewrite.rs:137-147).
+        return DenseNatMap(
+            [
+                _rewrite(value[old], plan, f"{path}[{old}]")
+                for old in plan.order
+            ]
+        )
+    if isinstance(value, dict):
+        out = {
+            _rewrite(k, plan, f"{path}[key {k!r}]"):
+                _rewrite(v, plan, f"{path}[{k!r}]")
+            for k, v in value.items()
+        }
+        # dict subclasses (OrderedDict, defaultdict, Counter) rebuild as
+        # their own type when the one-arg constructor accepts a mapping;
+        # defaultdict's factory is restored explicitly.
+        if t is dict:
+            return out
+        if hasattr(value, "default_factory"):
+            fresh = t(value.default_factory)
+            fresh.update(out)
+            return fresh
+        return t(out)
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
         return type(value)(
             **{
-                f.name: rewrite(getattr(value, f.name), plan)
+                f.name: _rewrite(getattr(value, f.name), plan, f"{path}.{f.name}")
                 for f in dataclasses.fields(value)
             }
         )
-    if value is None or isinstance(value, (bool, int, float, str, bytes, Enum)):
+    if value is None or isinstance(
+        value, (bool, int, float, complex, str, bytes, bytearray, range, Enum)
+    ):
         return value
     raise TypeError(
-        f"Cannot rewrite value of type {t.__qualname__} for symmetry "
-        f"reduction: define a __rewrite__(plan) method."
+        f"cannot rewrite {path} (type {t.__qualname__}) for symmetry "
+        f"reduction: define a __rewrite__(plan) method on it."
     )

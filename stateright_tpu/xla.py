@@ -288,6 +288,7 @@ class XlaChecker(Checker):
         ladder: str = "auto",
         shrink_exit: str = "auto",
         cand_ladder: Any = "auto",
+        symmetry: Any = None,
         trace: Any = None,
         heartbeat: Any = None,
         metrics_to: Any = None,
@@ -347,11 +348,33 @@ class XlaChecker(Checker):
         )
         self._last_checkpoint: Optional[Dict[str, Any]] = None
         self._resumed_from: Optional[str] = checkpoint
-        self._symmetry = builder._symmetry is not None
-        if self._symmetry and not hasattr(model, "packed_representative"):
-            raise TypeError(
-                f"symmetry reduction under spawn_xla() requires "
-                f"{type(model).__name__}.packed_representative"
+        # Symmetry reduction (stateright_tpu/sym, docs/symmetry.md):
+        # resolve the spawn_xla(symmetry=) / STPU_SYMMETRY knob against
+        # the builder request and the model's capability. When on, the
+        # frontier canonicalizes through either the spec-compiled
+        # scatter-free kernel (tag "spec:<hash>") or the model's
+        # hand-written packed_representative; unsupported paths raise
+        # SymmetryUnsupported instead of silently exploring full-space.
+        from .sym import SymmetryUnsupported, resolve_symmetry
+
+        _sym = resolve_symmetry(
+            symmetry, builder._symmetry is not None, model, engine="xla"
+        )
+        self._symmetry = _sym.enabled
+        self._sym_tag = _sym.tag
+        self._sym_canon = _sym.device_canon
+        self._sym_canon_host = _sym.host_canon
+        if self._symmetry and getattr(model, "host_verified_properties", ()):
+            # The hv fallback re-runs exact host predicates on CONCRETE
+            # candidate states; a symmetry-reduced frontier only surfaces
+            # one member per class, so an asymmetric hv property could
+            # silently miss its witness. Typed refusal, not silent
+            # wrongness (ISSUE 19 satellite).
+            raise SymmetryUnsupported(
+                "xla",
+                f"{type(model).__name__} declares host_verified_properties; "
+                f"the host-verified fallback evaluates concrete states and "
+                f"cannot honor a symmetry-reduced frontier",
             )
         self._target_state_count: Optional[int] = builder._target_state_count
         self._target_max_depth: Optional[int] = builder._target_max_depth
@@ -798,10 +821,11 @@ class XlaChecker(Checker):
         import jax
         import jax.numpy as jnp
 
-        from .checkpoint import load_checkpoint, validate_model
+        from .checkpoint import load_checkpoint, validate_model, validate_symmetry
 
         ck = load_checkpoint(path)
         validate_model(ck["meta"], self._model, self._prop_names)
+        validate_symmetry(ck["meta"], self._sym_tag)
 
         n_entries = len(ck["key_hi"])
         # Power-of-two growth base: the delta structure's .capacity includes
@@ -885,9 +909,18 @@ class XlaChecker(Checker):
         symmetry is on (the packed analogue of dfs.rs:357-362)."""
         if not self._symmetry:
             return rows
-        reps = [
-            self._model.pack(self._model.unpack(row).representative()) for row in rows
-        ]
+        if self._sym_canon_host is not None:
+            # Spec path: the bit-exact numpy twin of the device kernel —
+            # no object round-trip, and exact agreement with device
+            # fingerprints even when the object representative() is a
+            # different (partial) canonicalization.
+            canon = self._sym_canon_host
+            reps = [canon(np.asarray(row, dtype=np.uint32)) for row in rows]
+        else:
+            reps = [
+                self._model.pack(self._model.unpack(row).representative())
+                for row in rows
+            ]
         return np.stack(reps) if reps else rows
 
     def _packed_fp64(self, state: Any) -> int:
@@ -983,12 +1016,13 @@ class XlaChecker(Checker):
 
         model = self._model
         symmetry = self._symmetry
+        sym_canon = self._sym_canon
         A, W = self._A, self._W
         max_probes = self._max_probes
         hv_cap = self._hv_cap
 
         def dedup_words(words):
-            return model.packed_representative(words) if symmetry else words
+            return sym_canon(words) if symmetry else words
 
         ds = self._ds
         gather_compact = self._dedup == "sorted"
@@ -1172,13 +1206,14 @@ class XlaChecker(Checker):
 
         model = self._model
         symmetry = self._symmetry
+        sym_canon = self._sym_canon
         A, W = self._A, self._W
         max_probes = self._max_probes
         hv_cap = self._hv_cap
         ds = self._ds
 
         def dedup_words(words):
-            return model.packed_representative(words) if symmetry else words
+            return sym_canon(words) if symmetry else words
 
         def step3(words):
             out = model.packed_step(words)
@@ -1844,7 +1879,7 @@ class XlaChecker(Checker):
         # identically, and evicting it would force a byte-identical
         # recompile (~11 s/bucket on this box, ~1 min on the tunnel).
         pinning = [
-            (s._symmetry, s._max_probes, s._dedup, s._compaction)
+            (s._sym_tag, s._max_probes, s._dedup, s._compaction)
             for s in self._siblings()
             if not s.is_done()
             and s._cand_caps.get(run_cap, s._default_cand_cap(run_cap)) == old
@@ -1945,7 +1980,7 @@ class XlaChecker(Checker):
 
     def _superstep_key(self, f_cap: int):
         return (
-            f_cap, self._cand_cap_for(f_cap), self._symmetry,
+            f_cap, self._cand_cap_for(f_cap), self._sym_tag,
             self._max_probes, self._dedup, self._compaction,
         )
 
@@ -1961,7 +1996,7 @@ class XlaChecker(Checker):
 
     def _fused_key(self, f_cap: int):
         return (
-            "fused", f_cap, tuple(self._cand_rungs(f_cap)), self._symmetry,
+            "fused", f_cap, tuple(self._cand_rungs(f_cap)), self._sym_tag,
             self._max_probes, self._dedup, self._compaction,
         )
 
@@ -2094,7 +2129,7 @@ class XlaChecker(Checker):
         """Run buckets holding a live compiled program for the dispatch
         flavor and engine config this checker would actually invoke."""
         fused = self._levels_per_dispatch > 1
-        tail_want = (self._symmetry, self._max_probes, self._dedup, self._compaction)
+        tail_want = (self._sym_tag, self._max_probes, self._dedup, self._compaction)
         caps = set()
         for k in self._superstep_cache:
             if fused != (k[0] == "fused"):
@@ -2391,6 +2426,7 @@ class XlaChecker(Checker):
                         "frontier": int(lvf[i]),
                         "generated": int(lvs[i]),
                         "unique": int(lvu[i]),
+                        "sym": self._sym_tag,
                         # Dispatch-shape telemetry: the (rows, cand)
                         # sub-widths this level actually ran at and the
                         # cost-law lane-words they imply (the ladder A/B's
@@ -2573,6 +2609,7 @@ class XlaChecker(Checker):
                 "frontier": self._frontier_count,
                 "generated": int(d_states),
                 "unique": int(d_unique),
+                "sym": self._sym_tag,
                 # The one-level path picks its snug bucket host-side, so
                 # its dispatch-shape telemetry is the run bucket itself
                 # (the in-program ladder applies to fused dispatch only).
@@ -2746,6 +2783,7 @@ class XlaChecker(Checker):
             # -- configuration gauges ---------------------------------
             "dedup": self._dedup,
             "compaction": self._compaction,
+            "symmetry": self._sym_tag,
             "ladder": self._ladder,
             "cand_ladder_k": self._cand_ladder_k,
             "shrink_exit": self._shrink_exit,

@@ -93,7 +93,7 @@ def _check_lanes(lanes: List[XlaChecker]) -> None:
     for ln in lanes:
         if ln._visitor is not None:
             raise MuxError("visitors cannot be multiplexed")
-    for attr in ("_dedup", "_compaction", "_symmetry", "_max_probes", "_soa"):
+    for attr in ("_dedup", "_compaction", "_sym_tag", "_max_probes", "_soa"):
         vals = {getattr(ln, attr) for ln in lanes}
         if len(vals) != 1:
             raise MuxError(
@@ -150,7 +150,7 @@ class MuxChecker:
         lead = self.lanes[0]
         return (
             "mux", self.k, f_cap, cand_cap, self._levels_per_dispatch,
-            lead._symmetry, lead._max_probes, lead._dedup, lead._compaction,
+            lead._sym_tag, lead._max_probes, lead._dedup, lead._compaction,
         )
 
     def _mux_fused_for(self, run_cap: int, cand_cap: int):

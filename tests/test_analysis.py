@@ -335,12 +335,14 @@ def test_stpu008_flags_one_sided_pathology_op():
 def test_stpu008_shipped_kernels_lower_identically():
     """Both width classes' transition kernels produce identical
     pathology-op inventories on cpu and tpu lowerings (the integration
-    form; the sweep runs these surfaces by default — the solo kernel
-    and the ISSUE 16 batched mux superstep)."""
+    form; the sweep runs these surfaces by default — the solo kernel,
+    the ISSUE 16 batched mux superstep, and the ISSUE 19 symmetry
+    canonicalization kernel)."""
     reports = {r.name: r for r in run_sweep(only=["lower:2pc:3"])}
     assert set(reports) == {
         "lower:2pc:3:packed_step",
         "lower:2pc:3:mux-superstep:k2",
+        "lower:2pc:3:sym-canon",
     }
     for rep in reports.values():
         assert rep.error == "", rep.error

@@ -282,7 +282,7 @@ def test_grow_does_not_evict_live_sibling_programs():
     c2 = model.checker().spawn_xla(**KW)
     base = c2._cand_cap_for(1024)
     key = (
-        1024, base, c2._symmetry, c2._max_probes, c2._dedup, c2._compaction,
+        1024, base, c2._sym_tag, c2._max_probes, c2._dedup, c2._compaction,
     )
     c2._superstep_cache[key] = marker = object()
     c1._grow_cand_cap(1024)
@@ -295,7 +295,7 @@ def test_grow_does_not_evict_live_sibling_programs():
     # re-grow from a fresh checker whose caps start at the hinted base*4.
     c3 = model.checker().spawn_xla(**KW)
     stale = (
-        1024, base * 4, c3._symmetry, c3._max_probes, c3._dedup,
+        1024, base * 4, c3._sym_tag, c3._max_probes, c3._dedup,
         c3._compaction,
     )
     c3._superstep_cache[stale] = object()

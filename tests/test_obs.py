@@ -50,7 +50,8 @@ DISPATCH_ATTRS = {
 #: The stable device-engine metrics key set (single-chip engine; the mesh
 #: engine adds mesh gauges on top of the same set).
 METRIC_KEYS = {
-    "engine", "backend", "dedup", "compaction", "ladder", "cand_ladder_k",
+    "engine", "backend", "dedup", "compaction", "symmetry", "ladder",
+    "cand_ladder_k",
     "shrink_exit", "levels_per_dispatch", "state_count",
     "unique_state_count", "depth", "max_depth", "frontier_count",
     "frontier_capacity", "table_capacity", "table_occupancy", "dispatches",

@@ -1,0 +1,541 @@
+"""Device-side symmetry reduction (stateright_tpu/sym; docs/symmetry.md).
+
+Soundness ladder, weakest to strongest:
+
+- the compiled kernel is bit-identical to its host numpy twin over every
+  reachable state (differential fuzz);
+- canonicalization is idempotent and CLASS-INVARIANT: every block
+  permutation of a state canonicalizes to the same representative — the
+  property that makes reduced counts equal the number of reachable
+  equivalence classes on ANY traversal;
+- the device engines (single-chip, on-demand, 8-device mesh) agree with
+  the host object-state oracle (``object_canonicalizer``) on counts and
+  discoveries, across all three dedup backends;
+- unsupported paths refuse typed (``SymmetryUnsupported``) instead of
+  silently exploring full-space or, worse, silently under-counting.
+
+Count provenance (see docs/symmetry.md "Full vs partial canonicalization"):
+the reference's 665 at 2pc rm=5 (2pc.rs:170) is a DFS-traversal artifact
+of its PARTIAL canon (rm_state sort only) — reproduced here on the host
+DFS. The spec-compiled kernel is a FULL canonicalization, so the device
+count is the true class count: 80 / 166 / 314 at rm = 3 / 4 / 5.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from stateright_tpu.models.increment import PackedIncrement
+from stateright_tpu.models.increment_lock import PackedIncrementLock
+from stateright_tpu.models.two_phase_commit import PackedTwoPhaseSys, TwoPhaseSys
+from stateright_tpu.sym import (
+    BlockGroup,
+    SymmetrySpec,
+    SymmetryUnsupported,
+    canonicalize_host,
+    compile_canon,
+    object_canonicalizer,
+)
+
+CAPS = dict(frontier_capacity=1 << 10, table_capacity=1 << 13)
+
+
+def _reachable_rows(model) -> np.ndarray:
+    """Every reachable packed row of the FULL (unreduced) space."""
+    seen = set()
+    stack = list(model.init_states())
+    while stack:
+        s = stack.pop()
+        if s in seen:
+            continue
+        seen.add(s)
+        stack.extend(model.next_states(s))
+    return np.stack([np.asarray(model.pack(s), np.uint32) for s in seen])
+
+
+def _permute_blocks(spec: SymmetrySpec, row: np.ndarray, perm) -> np.ndarray:
+    """Apply a block permutation through the spec's own lane positions:
+    new block b takes old block perm[b]'s lane values. Generates the
+    group orbit the kernel claims to collapse — no model cooperation
+    needed, so the helper can't share a bug with the kernel under test."""
+    out = np.array(row, dtype=np.uint32, copy=True)
+    for g in spec.groups:
+        for lane in g.lanes:
+            mask = (1 << lane.bits) - 1
+            vals = [
+                (int(row[w]) >> s) & mask for (w, s) in lane.positions
+            ]
+            for new_b, (w, s) in enumerate(lane.positions):
+                out[w] = np.uint32(
+                    (int(out[w]) & ~(mask << s)) | (vals[perm[new_b]] << s)
+                )
+    return out
+
+
+# --- the <30s smoke drill (tools/smoke.sh) ---------------------------------
+
+
+def test_smoke_symmetry():
+    """Device symmetry end-to-end in one small model: forced-on device run
+    collapses 288 -> 80 classes, agrees with the host object-state oracle,
+    and reports its spec tag through metrics."""
+    m = PackedTwoPhaseSys(3)
+    dev = m.checker().spawn_xla(symmetry="on", **CAPS).join()
+    assert dev.unique_state_count() == 80
+    dev.assert_properties()
+    tag = dev.metrics()["symmetry"]
+    assert tag == f"spec:{m.symmetry_spec.spec_hash()[:12]}"
+
+    host = (
+        TwoPhaseSys(3)
+        .checker()
+        .symmetry_fn(object_canonicalizer(m))
+        .spawn_bfs()
+        .join()
+    )
+    assert host.unique_state_count() == 80
+    host.assert_properties()
+
+    # Off stays full-space; the tag is None on every off path.
+    off = m.checker().spawn_xla(**CAPS).join()
+    assert off.unique_state_count() == 288
+    assert off.metrics()["symmetry"] is None
+
+
+# --- count pins (class counts are traversal-invariant) ---------------------
+
+
+def test_device_2pc_rm4_class_count():
+    c = (
+        PackedTwoPhaseSys(4)
+        .checker()
+        .symmetry()
+        .spawn_xla(frontier_capacity=1 << 11, table_capacity=1 << 13)
+        .join()
+    )
+    assert c.unique_state_count() == 166
+    c.assert_properties()
+
+
+class _FullSpace:
+    """Replace the always-props with an unreachable sometimes so the search
+    exhausts the space — increment's "fin" race would otherwise early-exit
+    the engine before the count stabilizes (same trick as
+    test_packed_increment.py)."""
+
+    def properties(self):
+        from stateright_tpu.core import Property
+
+        return [Property.sometimes("unreachable", lambda _m, _s: False)]
+
+    def packed_properties(self, words):
+        return jnp.stack([jnp.bool_(False)])
+
+
+class _IncrementFull(_FullSpace, PackedIncrement):
+    pass
+
+
+class _IncrementLockFull(_FullSpace, PackedIncrementLock):
+    pass
+
+
+@pytest.mark.parametrize(
+    "model_cls,n,full,reduced",
+    [
+        (_IncrementFull, 2, 13, 8),
+        (_IncrementFull, 3, 84, 22),
+        (_IncrementLockFull, 2, 17, 9),
+        (_IncrementLockFull, 3, 61, 13),
+    ],
+)
+def test_device_increment_class_counts(model_cls, n, full, reduced):
+    caps = dict(frontier_capacity=1 << 8, table_capacity=1 << 10)
+    off = model_cls(n).checker().spawn_xla(**caps).join()
+    assert off.unique_state_count() == full
+    on = model_cls(n).checker().symmetry().spawn_xla(**caps).join()
+    assert on.unique_state_count() == reduced
+
+
+def test_increment_race_survives_reduction():
+    """The "fin" race counterexample (increment.rs:63-71) must still
+    surface from the symmetry-reduced space — a reduction that lost a
+    discovery would be unsound, not just miscounted."""
+    caps = dict(frontier_capacity=1 << 8, table_capacity=1 << 10)
+    on = PackedIncrement(2).checker().symmetry().spawn_xla(**caps).join()
+    assert "fin" in on.discoveries()
+    final = on.discoveries()["fin"].last_state()
+    assert sum(1 for _t, pc in final.s if pc == 3) != final.i
+
+
+@pytest.mark.parametrize("dedup", ["sorted", "hash", "delta"])
+def test_all_dedups_agree(dedup):
+    c = (
+        PackedTwoPhaseSys(3)
+        .checker()
+        .spawn_xla(symmetry="on", dedup=dedup, **CAPS)
+        .join()
+    )
+    assert c.unique_state_count() == 80
+    c.assert_properties()
+
+
+def test_host_full_canon_is_traversal_invariant():
+    """Class-invariant canon => BFS and DFS visit the same class count
+    (at rm=5 that's 314, NOT the reference's 665 — the 665 is the
+    partial-canon DFS artifact pinned in test_two_phase_commit.py and
+    below). rm=4 keeps this under a second."""
+    canon = object_canonicalizer(PackedTwoPhaseSys(4))
+    bfs = TwoPhaseSys(4).checker().symmetry_fn(canon).spawn_bfs().join()
+    dfs = TwoPhaseSys(4).checker().symmetry_fn(canon).spawn_dfs().join()
+    assert bfs.unique_state_count() == dfs.unique_state_count() == 166
+
+
+@pytest.mark.slow
+def test_host_full_canon_rm5_matches_device():
+    """The rm=5 host oracle for test_xla_engine.py's device 314 pin; the
+    same run shows the reference's partial canon (``.symmetry()`` on the
+    object model = rm_state-sort-only ``representative()``) is traversal-
+    DEPENDENT: its DFS lands on the reference's 665 (2pc.rs:170) while
+    its BFS lands elsewhere — neither is the class count."""
+    m = PackedTwoPhaseSys(5)
+    full_dfs = (
+        TwoPhaseSys(5)
+        .checker()
+        .symmetry_fn(object_canonicalizer(m))
+        .spawn_dfs()
+        .join()
+    )
+    assert full_dfs.unique_state_count() == 314
+
+    partial_dfs = TwoPhaseSys(5).checker().symmetry().spawn_dfs().join()
+    assert partial_dfs.unique_state_count() == 665
+    partial_bfs = TwoPhaseSys(5).checker().symmetry().spawn_bfs().join()
+    assert partial_bfs.unique_state_count() != 665
+    assert partial_bfs.unique_state_count() >= 314
+
+
+def test_device_matches_host_oracle_discoveries():
+    m = PackedTwoPhaseSys(3)
+    dev = m.checker().symmetry().spawn_xla(**CAPS).join()
+    host = (
+        TwoPhaseSys(3)
+        .checker()
+        .symmetry_fn(object_canonicalizer(m))
+        .spawn_bfs()
+        .join()
+    )
+    assert dev.unique_state_count() == host.unique_state_count() == 80
+    assert set(dev.discoveries()) == set(host.discoveries())
+
+
+# --- kernel soundness ------------------------------------------------------
+
+
+def test_kernel_matches_host_twin_and_is_idempotent():
+    m = PackedTwoPhaseSys(3)
+    rows = _reachable_rows(m)
+    dev = np.asarray(jax.jit(jax.vmap(compile_canon(m.symmetry_spec)))(
+        jnp.asarray(rows)
+    ))
+    host = np.stack([canonicalize_host(m.symmetry_spec, r) for r in rows])
+    np.testing.assert_array_equal(dev, host)
+    # canon o canon == canon (a canonical form is its own representative).
+    host2 = np.stack([canonicalize_host(m.symmetry_spec, r) for r in host])
+    np.testing.assert_array_equal(host2, host)
+
+
+@pytest.mark.parametrize(
+    "model", [PackedTwoPhaseSys(3), PackedIncrement(3), PackedIncrementLock(3)]
+)
+def test_canon_is_class_invariant(model):
+    """EVERY block permutation of EVERY reachable state canonicalizes to
+    the same representative — the full-canonicalization property that
+    makes reduced counts traversal-invariant class counts."""
+    spec = model.symmetry_spec
+    rows = _reachable_rows(model)
+    count = spec.groups[0].count
+    base = np.stack([canonicalize_host(spec, r) for r in rows])
+    for perm in itertools.permutations(range(count)):
+        permuted = np.stack([_permute_blocks(spec, r, perm) for r in rows])
+        canon = np.stack([canonicalize_host(spec, r) for r in permuted])
+        np.testing.assert_array_equal(canon, base)
+
+
+@pytest.mark.parametrize("model", [PackedIncrement(3), PackedIncrementLock(3)])
+def test_spec_kernel_equals_packed_representative(model):
+    """increment/increment-lock derive their spec via from_layout over the
+    same (t, pc) key their hand-written packed_representative sorts by —
+    the spec kernel must be bit-identical to it (the models' docstrings
+    promise it; drift means from_layout or the kernel regressed)."""
+    rows = jnp.asarray(_reachable_rows(model))
+    spec_out = np.asarray(jax.vmap(compile_canon(model.symmetry_spec))(rows))
+    hand_out = np.asarray(jax.vmap(model.packed_representative)(rows))
+    np.testing.assert_array_equal(spec_out, hand_out)
+
+
+# --- typed refusal (SymmetryUnsupported regressions) -----------------------
+
+
+def test_forced_on_without_capability_refuses():
+    """Models with neither a spec nor packed_representative refuse typed
+    on every engine entry point (the regression: earlier builds silently
+    fell back to full-space on some paths)."""
+    from stateright_tpu.models.linearizable_register import PackedAbd
+
+    with pytest.raises(SymmetryUnsupported) as ei:
+        PackedAbd(2, 2).checker().spawn_xla(symmetry="on", **CAPS)
+    assert ei.value.engine == "xla"
+    assert "neither" in ei.value.reason
+    with pytest.raises(SymmetryUnsupported):
+        PackedAbd(2, 2).checker().spawn_on_demand(
+            engine="xla", symmetry="on", **CAPS
+        )
+
+
+def test_bad_symmetry_spec_type_refuses():
+    class Broken(PackedTwoPhaseSys):
+        def __init__(self):
+            super().__init__(3)
+            self.symmetry_spec = "not-a-spec"
+
+    with pytest.raises(SymmetryUnsupported, match="expected SymmetrySpec"):
+        Broken().checker().spawn_xla(symmetry="on", **CAPS)
+
+
+def test_spec_beyond_state_words_refuses():
+    class Widened(PackedTwoPhaseSys):
+        def __init__(self):
+            super().__init__(3)
+            w = self.state_words
+            self.symmetry_spec = SymmetrySpec(
+                [
+                    BlockGroup(
+                        "ghost", 2,
+                        (SymmetrySpec.lane(
+                            "ghost", 2, positions=[(w, 0), (w, 2)]
+                        ),),
+                    )
+                ]
+            )
+
+    with pytest.raises(SymmetryUnsupported, match="state_words"):
+        Widened().checker().spawn_xla(symmetry="on", **CAPS)
+
+
+def test_hv_properties_refuse_symmetry():
+    """A symmetry-reduced frontier surfaces ONE member per class; the
+    host-verified fallback re-checks concrete states, so an asymmetric hv
+    property could silently miss its witness — both device engines must
+    refuse, not under-check."""
+
+    class HvTwoPhase(PackedTwoPhaseSys):
+        def __init__(self, rm):
+            super().__init__(rm)
+            self.host_verified_properties = frozenset({"commit agreement"})
+
+    with pytest.raises(SymmetryUnsupported, match="host-verified"):
+        HvTwoPhase(3).checker().symmetry().spawn_xla(**CAPS)
+
+    if len(jax.devices()) >= 8:
+        from stateright_tpu.parallel import default_mesh
+
+        with pytest.raises(SymmetryUnsupported, match="host-verified"):
+            HvTwoPhase(3).checker().symmetry().spawn_xla(
+                mesh=default_mesh(8), **CAPS
+            )
+
+
+def test_object_canonicalizer_requires_spec():
+    from stateright_tpu.models.linearizable_register import PackedAbd
+
+    with pytest.raises(SymmetryUnsupported):
+        object_canonicalizer(PackedAbd(2, 2))
+
+
+# --- spec validation -------------------------------------------------------
+
+
+def _group(*lanes, count=2, name="g"):
+    return SymmetrySpec([BlockGroup(name, count, tuple(lanes))])
+
+
+def test_spec_validation_errors():
+    lane = SymmetrySpec.lane
+    # Overlapping bits across lanes of one group.
+    with pytest.raises(ValueError, match="overlap"):
+        _group(
+            lane("a", 2, positions=[(0, 0), (0, 2)]),
+            lane("b", 2, positions=[(0, 1), (0, 3)]),
+        )
+    with pytest.raises(ValueError, match="bits"):
+        _group(lane("a", 0, positions=[(0, 0), (0, 1)]))
+    with pytest.raises(ValueError, match="bits"):
+        _group(lane("a", 33, positions=[(0, 0), (1, 0)]))
+    # Every lane must carry one position per block.
+    with pytest.raises(ValueError, match="positions"):
+        _group(lane("a", 1, positions=[(0, 0), (0, 1), (0, 2)]))
+    # A lane spilling past bit 32 of its word.
+    with pytest.raises(ValueError, match="fit"):
+        _group(lane("a", 4, positions=[(0, 30), (0, 0)]))
+    # A one-block "group" has no symmetry to reduce.
+    with pytest.raises(ValueError, match="count"):
+        _group(lane("a", 1, positions=[(0, 0)]), count=1)
+    with pytest.raises(ValueError, match="no lanes"):
+        _group(count=2)
+    with pytest.raises(ValueError, match="at least one"):
+        SymmetrySpec([])
+
+
+def test_spec_hash_is_layout_sensitive():
+    lane = SymmetrySpec.lane
+    a = _group(lane("t", 2, positions=[(0, 0), (0, 2)]))
+    b = _group(lane("t", 2, positions=[(0, 0), (0, 4)]))
+    assert a.spec_hash() != b.spec_hash()
+    assert a.spec_hash() == _group(
+        lane("t", 2, positions=[(0, 0), (0, 2)])
+    ).spec_hash()
+
+
+# --- mode resolution (spawn arg vs STPU_SYMMETRY) --------------------------
+
+
+def test_env_forces_on(monkeypatch):
+    monkeypatch.setenv("STPU_SYMMETRY", "1")
+    c = PackedTwoPhaseSys(3).checker().spawn_xla(**CAPS).join()
+    assert c.unique_state_count() == 80
+
+
+def test_env_off_beats_builder(monkeypatch):
+    monkeypatch.setenv("STPU_SYMMETRY", "off")
+    c = PackedTwoPhaseSys(3).checker().symmetry().spawn_xla(**CAPS).join()
+    assert c.unique_state_count() == 288
+    assert c.metrics()["symmetry"] is None
+
+
+def test_arg_beats_env(monkeypatch):
+    monkeypatch.setenv("STPU_SYMMETRY", "1")
+    c = PackedTwoPhaseSys(3).checker().spawn_xla(symmetry="off", **CAPS).join()
+    assert c.unique_state_count() == 288
+
+
+def test_invalid_mode_raises():
+    with pytest.raises(ValueError, match="auto/on/off"):
+        PackedTwoPhaseSys(3).checker().spawn_xla(symmetry="sideways", **CAPS)
+
+
+# --- checkpoint identity ---------------------------------------------------
+
+
+def test_checkpoint_symmetry_mismatch_refuses(tmp_path):
+    """A checkpoint's visited table holds CANONICAL fingerprints; resuming
+    it under a different canonicalization would silently corrupt dedup —
+    the meta carries the sym tag and a mismatched resume fails typed."""
+    path = str(tmp_path / "ck.npz")
+    partial = PackedTwoPhaseSys(3).checker().spawn_xla(symmetry="on", **CAPS)
+    partial._run_block()
+    partial.save_checkpoint(path)
+
+    with pytest.raises(ValueError, match="symmetry"):
+        PackedTwoPhaseSys(3).checker().spawn_xla(checkpoint=path, **CAPS)
+
+    resumed = PackedTwoPhaseSys(3).checker().spawn_xla(
+        symmetry="on", checkpoint=path, **CAPS
+    ).join()
+    assert resumed.unique_state_count() == 80
+    resumed.assert_properties()
+
+
+def test_old_checkpoints_without_sym_key_still_load():
+    from stateright_tpu.checkpoint import validate_symmetry
+
+    validate_symmetry({}, None)  # pre-symmetry meta: skip, don't refuse
+    validate_symmetry({}, "spec:abc")
+    validate_symmetry({"symmetry": None}, None)
+    with pytest.raises(ValueError):
+        validate_symmetry({"symmetry": "spec:a"}, "spec:b")
+    with pytest.raises(ValueError):
+        validate_symmetry({"symmetry": "spec:a"}, None)
+
+
+# --- engines beyond the single-chip batch path -----------------------------
+
+
+def test_on_demand_targeted_expansion_canonicalizes():
+    m = PackedTwoPhaseSys(3)
+    c = m.checker().symmetry().spawn_on_demand(engine="xla", **CAPS)
+    init = list(m.init_states())[0]
+    c.check_state(init)  # targeted: one compiled superstep, canon applied
+    assert c.unique_state_count() >= 1
+    c.run_to_completion()
+    c.join()
+    assert c.unique_state_count() == 80
+    c.assert_properties()
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh"
+)
+def test_mesh_symmetry_matches_single_chip():
+    from stateright_tpu.parallel import default_mesh
+
+    m = PackedTwoPhaseSys(3)
+    c = m.checker().symmetry().spawn_xla(mesh=default_mesh(8), **CAPS).join()
+    assert c.unique_state_count() == 80
+    c.assert_properties()
+    assert c.metrics()["symmetry"] == f"spec:{m.symmetry_spec.spec_hash()[:12]}"
+
+
+def test_level_log_carries_sym_tag():
+    c = PackedTwoPhaseSys(3).checker().symmetry().spawn_xla(**CAPS).join()
+    tag = c.metrics()["symmetry"]
+    assert tag and tag.startswith("spec:")
+    assert c.level_log
+    assert all(row["sym"] == tag for row in c.level_log)
+
+    off = PackedTwoPhaseSys(3).checker().spawn_xla(**CAPS).join()
+    assert all(row["sym"] is None for row in off.level_log)
+
+
+# --- service integration ---------------------------------------------------
+
+
+def test_sym_families_matches_model_capability():
+    """registry.SYM_FAMILIES is static (the jax-free parent can't import
+    models); drift against the models' actual capability is THIS failure."""
+    from stateright_tpu.service import registry
+
+    for family in registry.FAMILIES:
+        model, _ = registry.resolve(family)
+        ships = getattr(model, "symmetry_spec", None) is not None
+        assert ships == (family in registry.SYM_FAMILIES), (
+            f"{family}: symmetry_spec={ships} but SYM_FAMILIES says "
+            f"{family in registry.SYM_FAMILIES}"
+        )
+
+
+def test_mux_partition_keys_on_symmetry():
+    """Mux lanes share ONE compiled canonicalization (xla_mux._check_lanes
+    pins _sym_tag across the group), so the scheduler must never batch a
+    symmetry-on job with a symmetry-off sibling."""
+    from types import SimpleNamespace
+
+    from stateright_tpu.service.core import CheckerService
+
+    def job(symmetry):
+        return SimpleNamespace(
+            spec="2pc:3", priority="batch", symmetry=symmetry,
+            engine_force=None, seed_checkpoint=None, _mux_solo=False,
+        )
+
+    fake = SimpleNamespace(
+        _cfg=SimpleNamespace(mux_k=4), _breaker="closed"
+    )
+    a, b, c = job(None), job(None), job("on")
+    groups = CheckerService._mux_partition(fake, [a, b, c])
+    assert sorted(len(g) for g in groups) == [1, 2]
+    assert [c] in groups  # the symmetry-on job rides alone

@@ -161,13 +161,14 @@ def test_xla_2pc_rm5_symmetry():
         .spawn_xla(frontier_capacity=1 << 12, table_capacity=1 << 14)
         .join()
     )
-    # The rm_state sort is a *partial* canonicalization (ties keep index
-    # order), so the visited-representative count depends on traversal
-    # order: the reference's DFS explores 665 (2pc.rs:170), our CPU DFS
-    # reproduces that, and the level-synchronous device BFS deterministically
-    # explores 508 of the 1092 total classes. Coverage of every reachable
-    # equivalence class is guaranteed either way, so properties still hold.
-    assert checker.unique_state_count() == 508
+    # The model ships a symmetry_spec (stateright_tpu/sym), so the builder
+    # request resolves to the spec-compiled FULL canonicalization — a
+    # class-invariant kernel whose visited count equals the number of
+    # reachable equivalence classes on ANY traversal (docs/symmetry.md).
+    # 314 is the rm=5 class count; the reference's 665 (2pc.rs:170) is a
+    # DFS-traversal artifact of its *partial* rm_state sort (ties keep
+    # index order; our CPU DFS reproduces it — tests/test_symmetry.py).
+    assert checker.unique_state_count() == 314
     checker.assert_properties()
 
 

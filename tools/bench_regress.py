@@ -119,6 +119,15 @@ def load_trajectory(archive_dir: str) -> Dict[str, Dict[str, Any]]:
             if float(mux["jobs_per_sec"]) > entry.get("mux_best", 0.0):
                 entry["mux_best"] = float(mux["jobs_per_sec"])
                 entry["mux_best_file"] = os.path.basename(path)
+        # Symmetry-reduction baseline (BENCH_SYM; docs/symmetry.md):
+        # archived rounds that ran the sym A/B carry its row — the
+        # per-platform best off/on wall-clock ratio becomes the sym
+        # trajectory (same no_baseline-safe contract as mux).
+        sym = (doc.get("sym") if isinstance(doc, dict) else None) or line.get("sym")
+        if isinstance(sym, dict) and sym.get("speedup"):
+            if float(sym["speedup"]) > entry.get("sym_best", 0.0):
+                entry["sym_best"] = float(sym["speedup"])
+                entry["sym_best_file"] = os.path.basename(path)
     return out
 
 
@@ -136,6 +145,7 @@ def normalize_fresh(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "lint_ok": doc.get("lint_ok"),
             "fleet": doc.get("fleet"),
             "mux": doc.get("mux"),
+            "sym": doc.get("sym"),
             "full_coverage": doc.get("count_ok") is not None,
             "metric": doc["metric"],
         }
@@ -149,6 +159,7 @@ def normalize_fresh(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "lint_ok": doc.get("lint_ok"),
             "fleet": doc.get("fleet"),
             "mux": doc.get("mux"),
+            "sym": doc.get("sym"),
             "full_coverage": doc.get("full_coverage"),
             "metric": f"bench_detail rm={doc.get('rm')}",
         }
@@ -287,6 +298,48 @@ def judge(
             )
     # No "skip" row when the probe never ran: the mux mode is an env
     # opt-in (BENCH_MUX), not a default stage of every bench.
+
+    # -- symmetry-reduction A/B (BENCH_SYM) --------------------------------
+    sym = fresh.get("sym")
+    if isinstance(sym, dict):
+        audit = sym.get("audit") or {}
+        if sym.get("error") or audit.get("ok") is False:
+            checks.append(
+                _check(
+                    "sym", "fail",
+                    "sym A/B probe "
+                    + (f"errored: {sym['error']}" if sym.get("error") else
+                       f"failed the reduced-run audit: {audit}"),
+                )
+            )
+        elif base is None or not base.get("sym_best"):
+            checks.append(
+                _check(
+                    "sym", "skip",
+                    f"no archived {platform} sym baseline yet "
+                    f"({sym.get('spec')}: {sym.get('unique_full')} -> "
+                    f"{sym.get('unique_reduced')} uniques, speedup "
+                    f"{sym.get('speedup')}); banking this one starts the "
+                    "trajectory",
+                )
+            )
+        else:
+            floor = base["sym_best"] * (1.0 - tolerance)
+            ok = float(sym.get("speedup", 0.0)) >= floor
+            checks.append(
+                _check(
+                    "sym", "pass" if ok else "fail",
+                    f"speedup {sym.get('speedup')} on {sym.get('spec')} "
+                    f"({sym.get('unique_full')} -> "
+                    f"{sym.get('unique_reduced')} uniques) vs {platform} "
+                    f"sym best {base['sym_best']} "
+                    f"({base.get('sym_best_file')}); floor {floor:.3f} at "
+                    f"tolerance {tolerance}",
+                    value=sym.get("speedup"), baseline=base["sym_best"],
+                    floor=round(floor, 3),
+                )
+            )
+    # Same opt-in contract as mux: no row when BENCH_SYM never ran.
 
     # -- chaos SLO line ----------------------------------------------------
     if chaos is None:

@@ -30,7 +30,11 @@
 # saturated pool: best_effort sheds first with a measured Retry-After,
 # batch sheds at its own threshold, interactive admits until the hard
 # cap, quotas/gauges/deadline validation pinned — the QoS tier's tier-0
-# proof).
+# proof), and the <30s SYMMETRY drill (device symmetry reduction on one
+# packed model: the spec-compiled canonicalization collapses 2pc rm=3's
+# 288 states to the pinned 80 equivalence classes, bit-equal to the host
+# object-state oracle, with the spec tag in metrics — the symmetry
+# tier's tier-0 proof).
 # A red here means don't bother starting the full run.
 #
 # Usage: tools/smoke.sh [extra pytest args]
@@ -63,4 +67,5 @@ exec timeout -k 10 480 python -m pytest \
   tests/test_service_durability.py::test_smoke_service_restart_resume \
   tests/test_mux.py::test_smoke_mux \
   tests/test_trace_collect.py::test_smoke_trace_merge \
+  tests/test_symmetry.py::test_smoke_symmetry \
   -x -q -p no:cacheprovider "$@"

@@ -87,6 +87,12 @@ fi
 # full-fleet SIGKILL-restart, torn journal, AND a device.lost kill —
 # exactly-once + bit-identical across migrations, per-device SLOs in
 # runs/service_chaos.json's "fleet" dicts.
+# A bare "sym_ab" expands to the on-chip symmetry A/B (docs/symmetry.md):
+# BENCH_SYM=1 bench.py runs one shipped spec full-space vs reduced on
+# the tunnel — the runtime verdict on whether the in-superstep
+# canonicalization network is free against the table sorts it shrinks
+# (the sym dict lands in runs/bench_detail.json; bench_regress gates it
+# once banked).
 # A bare "qos_chaos" expands to the multi-tenant QoS sweep (ISSUE 18):
 # a seeded mixed-priority tenant schedule with the tenant.storm burst,
 # mid-storm SIGKILL + restart, the per-class shed/Retry-After probe —
@@ -99,6 +105,8 @@ for i in "${!STAGES[@]}"; do
     STAGES[$i]="service_chaos,1800,runs/service_chaos.log,python tools/service_chaos.py --seed 42 --jobs 3"
   elif [ "${STAGES[$i]}" = "fleet_chaos" ]; then
     STAGES[$i]="fleet_chaos,2400,runs/fleet_chaos.log,python tools/service_chaos.py --seed 42 --jobs 4 --fleet 2 --sessions 4"
+  elif [ "${STAGES[$i]}" = "sym_ab" ]; then
+    STAGES[$i]="sym_ab,3600,runs/sym_ab.log,env BENCH_SYM=1 BENCH_MATRIX=0 python bench.py"
   elif [ "${STAGES[$i]}" = "qos_chaos" ]; then
     STAGES[$i]="qos_chaos,2400,runs/qos_chaos.log,python tools/service_chaos.py --seed 42 --jobs 6 --tenants 12 --scenario storm --overload"
   elif [ "${STAGES[$i]}" = "bench_regress" ]; then

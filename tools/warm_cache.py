@@ -24,6 +24,7 @@ Usage::
     python tools/warm_cache.py --specs 2pc:4 paxos:2,3
     python tools/warm_cache.py --platform cpu  # warm the CPU cache (CI)
     python tools/warm_cache.py --mux 4         # + the K=4 batched programs
+    python tools/warm_cache.py --sym           # + the symmetry-variant programs
 
 ``--mux K`` additionally banks the multiplexed-superstep programs a
 service running with ``STPU_MUX=K`` compiles (the census's ``mux`` shape
@@ -31,6 +32,12 @@ classes — ``plan_for(..., mux_k=K)``): after each eligible spec's solo
 warm, one K-lane ``worker.py --mux`` group of that spec runs to
 completion, landing the batched (k, bucket, cand_cap) programs in the
 same cache. Specs outside ``registry.MUX_FAMILIES`` warm solo only.
+
+``--sym`` additionally banks the symmetry-variant programs
+(docs/symmetry.md; the census's ``sym`` shape classes —
+``plan_for(..., symmetry=True)``): after the solo warms, each
+``registry.SYM_FAMILIES`` spec re-runs its worker with ``STPU_SYMMETRY=1``
+so the canonicalization-fused bucket programs land in the same cache.
 
 Emits one JSON line per spec and a final summary. Re-running is cheap:
 already-cached programs load in seconds, so this doubles as a cache
@@ -111,6 +118,11 @@ def main() -> int:
         help="also pre-warm the K-lane multiplexed programs "
              "(one worker.py --mux group per MUX_FAMILIES spec)",
     )
+    p.add_argument(
+        "--sym", action="store_true",
+        help="also pre-warm the symmetry-variant programs "
+             "(STPU_SYMMETRY=1 worker run per SYM_FAMILIES spec)",
+    )
     args = p.parse_args()
 
     if args.specs is None:
@@ -161,6 +173,50 @@ def main() -> int:
             )
         summary.append(row)
         print(json.dumps(row), flush=True)
+
+    if args.sym:
+        from stateright_tpu.service.registry import SYM_FAMILIES
+
+        for spec in args.specs:
+            if parse(spec)[0] not in SYM_FAMILIES:
+                continue
+            tag = spec.replace(":", "_").replace(",", "-")
+            out = os.path.join(args.out_dir, f"warm_{tag}_sym.json")
+            t0 = time.monotonic()
+            res = sup.run_worker(
+                [
+                    sys.executable, WORKER,
+                    "--spec", spec,
+                    "--engine", "xla",
+                    "--platform", args.platform,
+                    "--out", out,
+                    "--max-seconds", str(args.budget_s),
+                ],
+                heartbeat=os.path.join(args.out_dir, f"warm_{tag}_sym_hb.json"),
+                timeout_s=args.budget_s * 1.5 + 60.0,
+                stall_s=args.stall_s,
+                startup_grace_s=600.0,
+                poll_s=1.0,
+                env=dict(env, STPU_SYMMETRY="1"),
+                stdout_path=os.path.join(args.out_dir, f"warm_{tag}_sym.out"),
+            )
+            row = {
+                "spec": spec,
+                "sym": True,
+                "ok": res.ok,
+                "seconds": round(time.monotonic() - t0, 2),
+                "killed": res.killed,
+                "rc": res.rc,
+            }
+            if res.ok and os.path.exists(out):
+                with open(out) as fh:
+                    r = json.load(fh)
+                row.update(
+                    generated=r["generated"], unique=r["unique"],
+                    platform=r["platform"],
+                )
+            summary.append(row)
+            print(json.dumps(row), flush=True)
 
     if args.mux > 1:
         from stateright_tpu.service.registry import MUX_FAMILIES
